@@ -1,0 +1,38 @@
+"""kaminpar_tpu — TPU-native balanced k-way graph partitioning.
+
+A brand-new JAX/XLA framework with the capabilities of KaHIP/KaMinPar
+(deep multilevel partitioning: LP coarsening, pool bipartitioning,
+LP/JET/balancer refinement), designed TPU-first per SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: multilevel runs hit a bounded set of
+# power-of-2 kernel shapes (see graph/csr.py PaddedView); caching them on disk
+# makes every run after the first start hot.  Override dir or disable via env.
+if _os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") != "1":
+    _cache_dir = _os.environ.get(
+        "KAMINPAR_TPU_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla"),
+    )
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover — cache is an optimization only
+        pass
+
+from .context import Context, PartitioningMode
+from .presets import create_context_by_preset_name, create_default_context
+
+__all__ = [
+    "Context",
+    "PartitioningMode",
+    "create_context_by_preset_name",
+    "create_default_context",
+]

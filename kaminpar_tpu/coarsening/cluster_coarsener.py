@@ -1,0 +1,84 @@
+"""Cluster coarsener: clustering + contraction hierarchy driver.
+
+Reference: ``AbstractClusterCoarsener``
+(``kaminpar-shm/coarsening/abstract_cluster_coarsener.cc``): compute a
+clustering of the current graph, contract it, push the level; ``uncoarsen``
+pops a level and projects the partition up (:148-170).  The TPU version keeps
+the hierarchy as host objects over device arrays; every level is one LP
+clustering (ops/lp.py) plus one sort-reduce contraction (ops/contraction.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..context import ClusteringAlgorithm, Context
+from ..graph.csr import CSRGraph
+from ..ops.contraction import contract_clustering, project_partition
+from ..utils.logger import Logger, OutputLevel
+from ..utils.timer import scoped_timer
+from .lp_clusterer import LPClustering
+from .max_cluster_weights import compute_max_cluster_weight
+
+
+@dataclass
+class CoarseLevel:
+    graph: CSRGraph  # the coarse graph produced at this level
+    coarse_of: object  # fine-node -> coarse-node map (device array)
+
+
+class ClusterCoarsener:
+    def __init__(self, ctx: Context, graph: CSRGraph):
+        self.ctx = ctx
+        self.input_graph = graph
+        self.hierarchy: List[CoarseLevel] = []
+        if ctx.coarsening.algorithm == ClusteringAlgorithm.LP:
+            self.clusterer: Optional[LPClustering] = LPClustering(ctx.coarsening.lp)
+        else:
+            self.clusterer = None
+
+    @property
+    def current_graph(self) -> CSRGraph:
+        return self.hierarchy[-1].graph if self.hierarchy else self.input_graph
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.hierarchy)
+
+    def coarsen_once(self, k: int, epsilon: float) -> bool:
+        """One coarsening level; returns False when converged (shrink factor
+        below threshold, reference abstract_cluster_coarsener convergence)."""
+        if self.clusterer is None:
+            return False
+        graph = self.current_graph
+        max_cw = compute_max_cluster_weight(
+            self.ctx.coarsening, graph.n, graph.total_node_weight, k, epsilon
+        )
+        with scoped_timer("coarsening"):
+            labels = self.clusterer.compute_clustering(graph, max_cw)
+            coarse, coarse_of = contract_clustering(graph, labels)
+        shrink = 1.0 - coarse.n / max(graph.n, 1)
+        Logger.log(
+            f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "
+            f"m={graph.m} -> {coarse.m} (max_cw={max_cw})",
+            OutputLevel.DEBUG,
+        )
+        if shrink < self.ctx.coarsening.convergence_threshold:
+            return False
+        self.hierarchy.append(CoarseLevel(coarse, coarse_of))
+        return True
+
+    def coarsen(self, k: int, epsilon: float, target_n: int) -> CSRGraph:
+        """Coarsen until ``n <= target_n`` or convergence (reference:
+        deep_multilevel.cc:86-149 coarsening loop)."""
+        while self.current_graph.n > target_n:
+            if not self.coarsen_once(k, epsilon):
+                break
+        return self.current_graph
+
+    def uncoarsen(self, partition):
+        """Pop one level, project the partition to the finer graph."""
+        level = self.hierarchy.pop()
+        with scoped_timer("uncoarsening"):
+            return project_partition(level.coarse_of, partition)

@@ -1,0 +1,73 @@
+"""LP clusterer: the LP engine instantiated for coarsening.
+
+Reference: ``kaminpar-shm/coarsening/clustering/lp_clusterer.cc`` — clustering
+labels are node ids (ClusterID = NodeID), up to ``num_iterations`` sweeps with
+early break on (near-)zero moves (lp_clusterer.cc:94-105), followed by
+isolated-node and two-hop handling (:107-162).
+
+Runs on the graph's shape-bucketed :class:`PaddedView`: pad nodes start in the
+anchor's cluster and never move (they have no edges), so one compile per
+power-of-2 bucket serves every hierarchy level of that size.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..context import LabelPropagationContext
+from ..graph.csr import CSRGraph
+from ..ops import lp
+from ..utils import next_key
+from ..utils.timer import scoped_timer
+
+
+class LPClustering:
+    def __init__(self, ctx: LabelPropagationContext):
+        self.ctx = ctx
+
+    def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
+        """Returns padded labels (over graph.padded()); pad nodes carry the
+        anchor label."""
+        pv = graph.padded()
+        n_pad = pv.n_pad
+        idt = pv.row_ptr.dtype
+        labels = jnp.concatenate(
+            [
+                jnp.arange(pv.n, dtype=idt),
+                jnp.full(n_pad - pv.n, pv.anchor, dtype=idt),
+            ]
+        )
+        state = lp.init_state(labels, pv.node_w, n_pad)
+        max_w = jnp.full(n_pad, int(max_cluster_weight), dtype=idt)
+
+        with scoped_timer("lp_clustering"):
+            for _ in range(self.ctx.num_iterations):
+                state = lp.lp_round(
+                    state,
+                    next_key(),
+                    pv.edge_u,
+                    pv.col_idx,
+                    pv.edge_w,
+                    pv.node_w,
+                    max_w,
+                    num_labels=n_pad,
+                )
+                if int(state.num_moved) <= self.ctx.min_moved_fraction * pv.n:
+                    break
+
+            if self.ctx.cluster_isolated_nodes:
+                state = lp.cluster_isolated_nodes(
+                    state, pv.row_ptr, pv.node_w, max_w, num_labels=n_pad
+                )
+            if self.ctx.cluster_two_hop_nodes:
+                state = lp.cluster_two_hop_nodes(
+                    state,
+                    next_key(),
+                    pv.edge_u,
+                    pv.col_idx,
+                    pv.edge_w,
+                    pv.node_w,
+                    max_w,
+                    num_labels=n_pad,
+                )
+        return state.labels

@@ -1,0 +1,234 @@
+"""Configuration tree for the TPU-native partitioner.
+
+Mirrors the reference's nested plain-struct ``Context``
+(``/root/reference/include/kaminpar-shm/kaminpar.h:610-622`` and the enums at
+``kaminpar.h:66-605``): one dataclass per subsystem, presets construct the tree
+fully in code (see :mod:`kaminpar_tpu.presets`), and the CLI binds flags
+directly onto the fields.  Unlike the reference we keep the tree small and add
+TPU-specific knobs (index dtype, device mesh shape) instead of TBB/NUMA ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class PartitioningMode(enum.Enum):
+    """Orchestration scheme (reference: ``PartitioningMode``, kaminpar.h:66)."""
+
+    DEEP = "deep"
+    RB = "rb"
+    KWAY = "kway"
+
+
+class ClusteringAlgorithm(enum.Enum):
+    """Coarsening clusterer (reference: ``ClusteringAlgorithm``)."""
+
+    NOOP = "noop"
+    LP = "lp"
+
+
+class RefinementAlgorithm(enum.Enum):
+    """Refiners composable into a pipeline (reference: ``RefinementAlgorithm``)."""
+
+    NOOP = "noop"
+    LP = "lp"
+    JET = "jet"
+    OVERLOAD_BALANCER = "overload-balancer"
+    GREEDY_BALANCER = "greedy-balancer"  # alias used by some presets
+
+
+class InitialPartitioningMode(enum.Enum):
+    SEQUENTIAL = "sequential"
+
+
+class TieBreakingStrategy(enum.Enum):
+    """LP tie-breaking (reference: ``TieBreakingStrategy``, kaminpar.h)."""
+
+    UNIFORM = "uniform"
+    GEOMETRIC = "geometric"
+
+
+class ClusterWeightLimit(enum.Enum):
+    """Max-cluster-weight formula (reference: coarsening/max_cluster_weights.h)."""
+
+    EPSILON_BLOCK_WEIGHT = "epsilon-block-weight"
+    BLOCK_WEIGHT = "block-weight"
+    ONE = "one"
+    ZERO = "zero"
+
+
+@dataclass
+class LabelPropagationContext:
+    """Knobs of the LP engine (reference: ``LabelPropagationCoarseningContext``
+    / ``LabelPropagationRefinementContext``, and the CRTP config block at
+    ``kaminpar-shm/label_propagation.h:36-74``)."""
+
+    num_iterations: int = 5
+    # Nodes with degree above this are handled by the dedicated high-degree
+    # (edge-parallel) path; mirrors the two-phase threshold of 10k at
+    # label_propagation.h:62.
+    large_degree_threshold: int = 1_000_000
+    max_num_neighbors: int = -1  # -1 = unlimited
+    tie_breaking: TieBreakingStrategy = TieBreakingStrategy.UNIFORM
+    # Stop sweeping early once fewer than this fraction of nodes moved.
+    min_moved_fraction: float = 0.001
+    # Cluster isolated nodes together at the end of coarsening LP
+    # (reference: label_propagation.h:872-917).
+    cluster_isolated_nodes: bool = True
+    # Match otherwise-unmergeable singleton clusters through their favored
+    # cluster (reference two-hop clustering, label_propagation.h:919-1120).
+    cluster_two_hop_nodes: bool = True
+
+
+@dataclass
+class CoarseningContext:
+    """Reference: ``CoarseningContext`` (kaminpar.h) + max_cluster_weights.h."""
+
+    algorithm: ClusteringAlgorithm = ClusteringAlgorithm.LP
+    lp: LabelPropagationContext = field(default_factory=LabelPropagationContext)
+    # Coarsen until n <= contraction_limit * k (kway) or 2*contraction_limit
+    # (deep); reference default C = 2000 (deep_multilevel.cc:170-183).
+    contraction_limit: int = 2000
+    # Stop coarsening when a level shrinks by less than this factor
+    # (reference: convergence_threshold).
+    convergence_threshold: float = 0.05
+    cluster_weight_limit: ClusterWeightLimit = ClusterWeightLimit.EPSILON_BLOCK_WEIGHT
+    cluster_weight_multiplier: float = 1.0
+
+
+@dataclass
+class InitialPartitioningContext:
+    """Reference: ``InitialPartitioningContext`` — pool of sequential flat
+    bipartitioners + 2-way FM (initial_pool_bipartitioner.cc:24)."""
+
+    mode: InitialPartitioningMode = InitialPartitioningMode.SEQUENTIAL
+    # Number of repetitions of each enabled flat bipartitioner.
+    min_num_repetitions: int = 4
+    max_num_repetitions: int = 12
+    num_seed_iterations: int = 1
+    use_adaptive_bipartitioner_selection: bool = True
+    enable_bfs_bipartitioner: bool = True
+    enable_ggg_bipartitioner: bool = True
+    enable_random_bipartitioner: bool = True
+    # 2-way FM refinement of each bipartition.
+    fm_num_iterations: int = 5
+    fm_alpha: float = 1.0  # adaptive stopping alpha (Osipov/Sanders)
+
+
+@dataclass
+class JetContext:
+    """Reference: ``JetRefinementContext`` (refinement/jet/jet_refiner.cc)."""
+
+    num_iterations: int = 12
+    num_fruitless_iterations: int = 12
+    fruitless_threshold: float = 0.999
+    # Negative-gain filter temperatures on fine/coarse levels
+    # (reference: jet_refiner.cc fine/coarse temperature schedule).
+    initial_gain_temp_on_fine_level: float = 0.25
+    final_gain_temp_on_fine_level: float = 0.25
+    initial_gain_temp_on_coarse_level: float = 0.75
+    final_gain_temp_on_coarse_level: float = 0.75
+
+
+@dataclass
+class BalancerContext:
+    max_num_rounds: int = 8
+
+
+@dataclass
+class RefinementContext:
+    """Pipeline of refiners, run in order on every uncoarsening level
+    (reference: MultiRefiner, factories.cc:97-147)."""
+
+    algorithms: tuple = (
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.LP,
+    )
+    lp: LabelPropagationContext = field(
+        default_factory=lambda: LabelPropagationContext(num_iterations=5)
+    )
+    jet: JetContext = field(default_factory=JetContext)
+    balancer: BalancerContext = field(default_factory=BalancerContext)
+
+
+@dataclass
+class PartitionContext:
+    """Target partition parameters (reference: ``PartitionContext``), filled in
+    by ``setup`` once graph + k are known (kaminpar.cc:315-331)."""
+
+    k: int = 2
+    epsilon: float = 0.03
+    # Filled by setup():
+    total_node_weight: int = 0
+    max_block_weights: Optional[object] = None  # np.ndarray[k], set by setup()
+
+    def setup(self, total_node_weight: int, k: int, epsilon: float) -> None:
+        import numpy as np
+
+        self.k = int(k)
+        self.epsilon = float(epsilon)
+        self.total_node_weight = int(total_node_weight)
+        perfect = (total_node_weight + k - 1) // k
+        max_bw = int((1.0 + epsilon) * perfect)
+        # Strict balance for unweighted graphs requires max >= perfect + max
+        # node weight; the facade adjusts for node weights (kaminpar.cc).
+        self.max_block_weights = np.full(k, max(max_bw, perfect + 1), dtype=np.int64)
+
+
+@dataclass
+class ParallelContext:
+    """TPU execution parameters (replaces the reference's thread counts)."""
+
+    # Shape of the device mesh for the distributed tier; None = single chip.
+    mesh_shape: Optional[tuple] = None
+    mesh_axis_names: tuple = ("nodes",)
+
+
+@dataclass
+class DebugContext:
+    save_hierarchy: bool = False
+    validate_graph: bool = False
+
+
+@dataclass
+class Context:
+    """Root of the config tree (reference: ``Context``, kaminpar.h:610-622)."""
+
+    preset_name: str = "default"
+    mode: PartitioningMode = PartitioningMode.KWAY
+    partition: PartitionContext = field(default_factory=PartitionContext)
+    coarsening: CoarseningContext = field(default_factory=CoarseningContext)
+    initial_partitioning: InitialPartitioningContext = field(
+        default_factory=InitialPartitioningContext
+    )
+    refinement: RefinementContext = field(default_factory=RefinementContext)
+    parallel: ParallelContext = field(default_factory=ParallelContext)
+    debug: DebugContext = field(default_factory=DebugContext)
+    seed: int = 0
+    # int32 by default; int64 mirrors the reference's 64-bit ID/weight build
+    # switches (CMakeLists.txt:71-79).
+    use_64bit_ids: bool = False
+
+    def to_dict(self) -> dict:
+        def conv(obj):
+            if dataclasses.is_dataclass(obj):
+                return {f.name: conv(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+            if isinstance(obj, enum.Enum):
+                return obj.value
+            if isinstance(obj, tuple):
+                return [conv(x) for x in obj]
+            if hasattr(obj, "tolist"):
+                return obj.tolist()
+            return obj
+
+        return conv(self)
+
+    def dump(self) -> str:
+        """Round-trippable config dump (reference: ``--dump-config``,
+        apps/KaMinPar.cc:107)."""
+        return json.dumps(self.to_dict(), indent=2)

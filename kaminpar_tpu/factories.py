@@ -1,0 +1,52 @@
+"""Component factories: enum → instance.
+
+Mirrors the reference's plugin seam (``kaminpar-shm/factories.cc:41-147``):
+``PartitioningMode`` → partitioner, ``ClusteringAlgorithm`` → clusterer,
+``RefinementAlgorithm`` list → MultiRefiner pipeline.
+"""
+
+from __future__ import annotations
+
+from .context import Context, PartitioningMode, RefinementAlgorithm
+from .graph.csr import CSRGraph
+from .refinement.balancer import OverloadBalancer
+from .refinement.jet import JetRefiner
+from .refinement.lp_refiner import LPRefiner
+from .refinement.refiner import MultiRefiner, NoopRefiner, Refiner
+
+
+def create_refiner(ctx: Context, *, coarse_level: bool = False) -> Refiner:
+    refiners = []
+    for algo in ctx.refinement.algorithms:
+        if algo == RefinementAlgorithm.NOOP:
+            continue
+        if algo == RefinementAlgorithm.LP:
+            refiners.append(LPRefiner(ctx.refinement.lp))
+        elif algo in (
+            RefinementAlgorithm.OVERLOAD_BALANCER,
+            RefinementAlgorithm.GREEDY_BALANCER,
+        ):
+            refiners.append(OverloadBalancer(ctx.refinement.balancer))
+        elif algo == RefinementAlgorithm.JET:
+            refiners.append(
+                JetRefiner(ctx.refinement.jet, ctx.refinement.balancer, coarse_level=coarse_level)
+            )
+        else:
+            raise ValueError(f"unhandled refinement algorithm {algo}")
+    if not refiners:
+        return NoopRefiner()
+    return MultiRefiner(refiners)
+
+
+def create_partitioner(ctx: Context, graph: CSRGraph):
+    from .partitioning.deep import DeepMultilevelPartitioner
+    from .partitioning.kway import KWayMultilevelPartitioner
+    from .partitioning.rb import RBMultilevelPartitioner
+
+    if ctx.mode == PartitioningMode.KWAY:
+        return KWayMultilevelPartitioner(ctx, graph)
+    if ctx.mode == PartitioningMode.DEEP:
+        return DeepMultilevelPartitioner(ctx, graph)
+    if ctx.mode == PartitioningMode.RB:
+        return RBMultilevelPartitioner(ctx, graph)
+    raise ValueError(f"unhandled partitioning mode {ctx.mode}")

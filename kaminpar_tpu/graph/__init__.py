@@ -1,0 +1,22 @@
+from . import generators, metrics
+from .csr import (
+    CSRGraph,
+    from_edge_list,
+    from_numpy_csr,
+    permute_nodes,
+    rearrange_by_degree_buckets,
+    validate,
+)
+from .partitioned import PartitionedGraph
+
+__all__ = [
+    "CSRGraph",
+    "PartitionedGraph",
+    "from_edge_list",
+    "from_numpy_csr",
+    "permute_nodes",
+    "rearrange_by_degree_buckets",
+    "validate",
+    "generators",
+    "metrics",
+]

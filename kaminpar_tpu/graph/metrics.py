@@ -1,0 +1,67 @@
+"""Partition quality metrics as jitted segment reductions.
+
+Reference: ``kaminpar-shm/metrics.{h,cc}`` — ``edge_cut`` (metrics.h:19),
+``imbalance``, ``total_overload``, ``is_feasible`` (metrics.h:19-60).  On TPU
+the edge cut is a single masked reduction over the edge list and block weights
+are one ``segment_sum`` — these are the "trivially TPU-native" metrics of
+SURVEY §7 stage 1.  All kernels run on the graph's shape-bucketed
+:class:`PaddedView` (weight-0 padding is inert) so they compile once per
+bucket, not once per hierarchy level.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .csr import CSRGraph
+
+
+def _pad_partition(graph: CSRGraph, partition):
+    pv = graph.padded()
+    return pv, pv.pad_node_array(jnp.asarray(partition), 0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _block_weights(labels, node_w, k: int):
+    return jax.ops.segment_sum(node_w, labels, num_segments=k)
+
+
+def block_weights(graph: CSRGraph, partition, k: int):
+    """Weight of every block (reference: PartitionedGraph::block_weights)."""
+    pv, part = _pad_partition(graph, partition)
+    return _block_weights(part, pv.node_w, k)
+
+
+@jax.jit
+def _edge_cut(edge_u, col_idx, edge_w, labels):
+    cut = labels[edge_u] != labels[col_idx]
+    return jnp.sum(jnp.where(cut, edge_w, 0)) // 2
+
+
+def edge_cut(graph: CSRGraph, partition) -> int:
+    """Total weight of cut edges (each undirected edge counted once).
+    Reference: ``metrics::edge_cut`` (metrics.cc)."""
+    pv, part = _pad_partition(graph, partition)
+    return int(_edge_cut(pv.edge_u, pv.col_idx, pv.edge_w, part))
+
+
+def imbalance(graph: CSRGraph, partition, k: int) -> float:
+    """max_b w(b) / ceil(W/k) - 1 (reference: ``metrics::imbalance``)."""
+    bw = np.asarray(block_weights(graph, partition, k))
+    perfect = -(graph.total_node_weight // -k)  # ceil(W/k), as in the reference
+    return float(bw.max() / perfect - 1.0) if perfect > 0 else 0.0
+
+
+def total_overload(graph: CSRGraph, partition, k: int, max_block_weights) -> int:
+    """Sum of overweight above the per-block limits (metrics.h)."""
+    bw = np.asarray(block_weights(graph, partition, k))
+    return int(np.maximum(bw - np.asarray(max_block_weights, dtype=np.int64), 0).sum())
+
+
+def is_feasible(graph: CSRGraph, partition, k: int, max_block_weights) -> bool:
+    """All block weights within limits (reference: ``metrics::is_feasible``)."""
+    return total_overload(graph, partition, k, max_block_weights) == 0

@@ -1,0 +1,51 @@
+"""Partition state over a CSR graph.
+
+Counterpart of the reference's ``GenericPartitionedGraph``
+(``kaminpar-shm/datastructures/partitioned_graph.h:50``): a partition array
+plus replicated block weights.  Where the reference uses atomic ``move_node``
+updates, the TPU version is functional — refiners produce new ``partition``
+arrays and block weights are recomputed by one ``segment_sum`` (cheap relative
+to the O(m) rating kernels).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import metrics
+from .csr import CSRGraph
+
+
+@dataclass
+class PartitionedGraph:
+    graph: CSRGraph
+    k: int
+    partition: object  # (n,) int array of block ids
+    max_block_weights: object  # (k,) int64 host array
+
+    @classmethod
+    def create(cls, graph: CSRGraph, k: int, partition, max_block_weights) -> "PartitionedGraph":
+        return cls(
+            graph=graph,
+            k=int(k),
+            partition=jnp.asarray(partition),
+            max_block_weights=np.asarray(max_block_weights, dtype=np.int64),
+        )
+
+    def block_weights(self):
+        return metrics.block_weights(self.graph, self.partition, self.k)
+
+    def edge_cut(self) -> int:
+        return metrics.edge_cut(self.graph, self.partition)
+
+    def imbalance(self) -> float:
+        return metrics.imbalance(self.graph, self.partition, self.k)
+
+    def is_feasible(self) -> bool:
+        return metrics.is_feasible(self.graph, self.partition, self.k, self.max_block_weights)
+
+    def with_partition(self, partition) -> "PartitionedGraph":
+        return PartitionedGraph(self.graph, self.k, jnp.asarray(partition), self.max_block_weights)

@@ -1,0 +1,316 @@
+"""Sequential initial bipartitioning pool + 2-way FM (host-side NumPy).
+
+Counterpart of the reference's initial partitioning tier
+(``kaminpar-shm/initial_partitioning/``): the coarsest graph is tiny, so the
+reference runs *sequential* flat bipartitioners — BFS
+(initial_bfs_bipartitioner.cc), greedy graph growing
+(initial_ggg_bipartitioner.cc), random (initial_random_bipartitioner.cc) —
+with adaptive repetition in a pool (initial_pool_bipartitioner.cc:24), each
+refined by sequential 2-way FM with adaptive stopping
+(initial_fm_refiner.cc).  Running this on host NumPy is the idiomatic TPU
+design, exactly as dKaMinPar replicates the coarsest graph onto one node and
+runs the shm code (SURVEY §7 stage 5).
+
+Graphs here are plain NumPy CSR tuples ``(row_ptr, col_idx, node_w, edge_w)``.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..context import InitialPartitioningContext
+
+
+class HostCSR(NamedTuple):
+    row_ptr: np.ndarray
+    col_idx: np.ndarray
+    node_w: np.ndarray
+    edge_w: np.ndarray
+
+    @property
+    def n(self) -> int:
+        return len(self.row_ptr) - 1
+
+    @property
+    def total_node_weight(self) -> int:
+        return int(self.node_w.sum())
+
+    def neighbors(self, u: int):
+        s, e = self.row_ptr[u], self.row_ptr[u + 1]
+        return self.col_idx[s:e], self.edge_w[s:e]
+
+
+def _cut(g: HostCSR, part: np.ndarray) -> int:
+    u = np.repeat(np.arange(g.n), np.diff(g.row_ptr))
+    return int(g.edge_w[part[u] != part[g.col_idx]].sum()) // 2
+
+
+def _block_weights(g: HostCSR, part: np.ndarray) -> np.ndarray:
+    return np.bincount(part, weights=g.node_w, minlength=2).astype(np.int64)
+
+
+def _grow_target(g: HostCSR, max_w: np.ndarray) -> int:
+    """Weight to grow block 0 toward: the proportional share of the total
+    (so uneven k0/k1 recursion splits stay balanced), capped by the budget."""
+    total = g.total_node_weight
+    share = int(np.ceil(total * max_w[0] / max(max_w[0] + max_w[1], 1)))
+    return min(int(max_w[0]), share)
+
+
+def _random_bipartition(g: HostCSR, max_w: np.ndarray, rng) -> np.ndarray:
+    """Reference: initial_random_bipartitioner.cc — random order fill up to
+    the proportional share."""
+    order = rng.permutation(g.n)
+    part = np.ones(g.n, dtype=np.int32)
+    w0 = 0
+    target = _grow_target(g, max_w)
+    for u in order:
+        if w0 + g.node_w[u] <= target:
+            part[u] = 0
+            w0 += int(g.node_w[u])
+    return part
+
+
+def _bfs_bipartition(g: HostCSR, max_w: np.ndarray, rng) -> np.ndarray:
+    """Reference: initial_bfs_bipartitioner.cc — grow block 0 by BFS from a
+    random seed until it reaches its weight budget."""
+    part = np.ones(g.n, dtype=np.int32)
+    if g.n == 0:
+        return part
+    seed = int(rng.integers(g.n))
+    target = _grow_target(g, max_w)
+    visited = np.zeros(g.n, dtype=bool)
+    queue = [seed]
+    visited[seed] = True
+    w0 = 0
+    while queue:
+        u = queue.pop(0)
+        if w0 + g.node_w[u] > target:
+            continue
+        part[u] = 0
+        w0 += int(g.node_w[u])
+        nbrs, _ = g.neighbors(u)
+        for v in nbrs:
+            if not visited[v]:
+                visited[v] = True
+                queue.append(int(v))
+    return part
+
+
+def _ggg_bipartition(g: HostCSR, max_w: np.ndarray, rng) -> np.ndarray:
+    """Reference: initial_ggg_bipartitioner.cc — greedy graph growing: grow
+    block 0 from a seed, always taking the frontier node with max gain
+    (external minus internal connection)."""
+    part = np.ones(g.n, dtype=np.int32)
+    if g.n == 0:
+        return part
+    seed = int(rng.integers(g.n))
+    target = _grow_target(g, max_w)
+    in_frontier = np.zeros(g.n, dtype=bool)
+    gain = np.zeros(g.n, dtype=np.int64)
+    heap: list = []
+    w0 = 0
+
+    def push(u):
+        in_frontier[u] = True
+        heapq.heappush(heap, (-int(gain[u]), int(rng.integers(1 << 30)), u))
+
+    push(seed)
+    while heap and w0 < target:
+        _, _, u = heapq.heappop(heap)
+        if part[u] == 0:
+            continue
+        if w0 + g.node_w[u] > target:
+            continue
+        part[u] = 0
+        w0 += int(g.node_w[u])
+        nbrs, ws = g.neighbors(u)
+        for v, w in zip(nbrs, ws):
+            if part[v] != 0:
+                gain[v] += 2 * int(w)  # v gained connection to block 0
+                push(int(v))
+    return part
+
+
+def _fm_refine_2way(
+    g: HostCSR,
+    part: np.ndarray,
+    max_w: np.ndarray,
+    rng,
+    num_iterations: int = 5,
+    alpha: float = 1.0,
+) -> np.ndarray:
+    """Sequential 2-way FM with adaptive (Osipov/Sanders) stopping.
+
+    Reference: initial_fm_refiner.cc — per pass: all border nodes enter a PQ
+    keyed by gain; repeatedly move the best-gain movable node, lock it, update
+    neighbor gains; roll back to the best prefix.
+    """
+    n = g.n
+    if n == 0:
+        return part
+    part = part.copy()
+    bw = _block_weights(g, part)
+
+    for _ in range(num_iterations):
+        # gains: external - internal connection weight
+        gain = np.zeros(n, dtype=np.int64)
+        u_arr = np.repeat(np.arange(n), np.diff(g.row_ptr))
+        same = part[u_arr] == part[g.col_idx]
+        np.add.at(gain, u_arr, np.where(same, -g.edge_w, g.edge_w))
+
+        locked = np.zeros(n, dtype=bool)
+        heap = [(-int(gain[u]), int(rng.integers(1 << 30)), int(u)) for u in range(n)]
+        heapq.heapify(heap)
+
+        best_cut_delta = 0
+        cur_delta = 0
+        moves: list = []
+        best_prefix = 0
+        fruitless = 0
+        max_fruitless = max(100, int(alpha * np.sqrt(n)))
+
+        while heap and fruitless < max_fruitless:
+            negg, _, u = heapq.heappop(heap)
+            if locked[u] or -negg != gain[u]:
+                continue  # stale entry
+            src, dst = part[u], 1 - part[u]
+            if bw[dst] + g.node_w[u] > max_w[dst]:
+                continue
+            # apply
+            locked[u] = True
+            part[u] = dst
+            bw[src] -= g.node_w[u]
+            bw[dst] += g.node_w[u]
+            cur_delta -= int(gain[u])
+            moves.append(u)
+            if cur_delta < best_cut_delta:
+                best_cut_delta = cur_delta
+                best_prefix = len(moves)
+                fruitless = 0
+            else:
+                fruitless += 1
+            nbrs, ws = g.neighbors(u)
+            for v, w in zip(nbrs, ws):
+                if locked[v]:
+                    continue
+                # u switched sides: edges to v flip internal/external
+                if part[v] == part[u]:
+                    gain[v] -= 2 * int(w)
+                else:
+                    gain[v] += 2 * int(w)
+                heapq.heappush(heap, (-int(gain[v]), int(rng.integers(1 << 30)), int(v)))
+
+        # roll back to best prefix
+        for u in moves[best_prefix:]:
+            src, dst = part[u], 1 - part[u]
+            part[u] = dst
+            bw[src] -= g.node_w[u]
+            bw[dst] += g.node_w[u]
+        if best_prefix == 0:
+            break
+    return part
+
+
+_FLAT_BIPARTITIONERS = {
+    "bfs": _bfs_bipartition,
+    "ggg": _ggg_bipartition,
+    "random": _random_bipartition,
+}
+
+
+def pool_bipartition(
+    g: HostCSR,
+    max_w: np.ndarray,
+    rng,
+    ctx: Optional[InitialPartitioningContext] = None,
+) -> np.ndarray:
+    """Run the enabled bipartitioners with repetitions + FM, keep the best
+    (feasibility first, then cut).  Reference: InitialPoolBipartitioner
+    (initial_pool_bipartitioner.cc:24) with adaptive selection simplified to
+    fixed repetitions."""
+    ctx = ctx or InitialPartitioningContext()
+    enabled = []
+    if ctx.enable_bfs_bipartitioner:
+        enabled.append("bfs")
+    if ctx.enable_ggg_bipartitioner:
+        enabled.append("ggg")
+    if ctx.enable_random_bipartitioner:
+        enabled.append("random")
+    reps = max(ctx.min_num_repetitions, 1)
+
+    best: Optional[Tuple[bool, int, np.ndarray]] = None
+    for name in enabled:
+        for _ in range(reps):
+            part = _FLAT_BIPARTITIONERS[name](g, max_w, rng)
+            part = _fm_refine_2way(
+                g, part, max_w, rng, ctx.fm_num_iterations, ctx.fm_alpha
+            )
+            bw = _block_weights(g, part)
+            feasible = bool((bw <= max_w).all())
+            cut = _cut(g, part)
+            cand = (feasible, -cut)
+            if best is None or cand > (best[0], -best[1]):
+                best = (feasible, cut, part)
+    assert best is not None, "no bipartitioner enabled"
+    return best[2]
+
+
+def extract_subgraph(
+    g: HostCSR, part: np.ndarray, block: int
+) -> Tuple[HostCSR, np.ndarray]:
+    """Block-induced subgraph + mapping sub-node -> original node.
+    Reference: graphutils/subgraph_extractor.h:176 (sequential variant)."""
+    nodes = np.flatnonzero(part == block)
+    remap = np.full(g.n, -1, dtype=np.int64)
+    remap[nodes] = np.arange(len(nodes))
+    deg = np.diff(g.row_ptr)
+    u_arr = np.repeat(np.arange(g.n), deg)
+    emask = (part[u_arr] == block) & (part[g.col_idx] == block)
+    sub_u = remap[u_arr[emask]]
+    sub_v = remap[g.col_idx[emask]]
+    sub_w = g.edge_w[emask]
+    sub_deg = np.bincount(sub_u, minlength=len(nodes))
+    row_ptr = np.zeros(len(nodes) + 1, dtype=g.row_ptr.dtype)
+    np.cumsum(sub_deg, out=row_ptr[1:])
+    order = np.lexsort((sub_v, sub_u))
+    sub = HostCSR(row_ptr, sub_v[order], g.node_w[nodes], sub_w[order])
+    return sub, nodes
+
+
+def recursive_bipartition(
+    g: HostCSR,
+    k: int,
+    max_block_weights: np.ndarray,
+    rng,
+    ctx: Optional[InitialPartitioningContext] = None,
+) -> np.ndarray:
+    """Partition into k blocks by recursive bisection.
+
+    Reference: ``extend_partition_recursive`` (partitioning/helper.cc:143) /
+    the RB scheme: split k into k0=ceil(k/2), k1=k-k0; the bisection's block
+    budgets are the sums of the final per-block budgets (so imbalance does not
+    accumulate through the recursion).
+    """
+    part = np.zeros(g.n, dtype=np.int32)
+    if k <= 1 or g.n == 0:
+        return part
+    k0 = (k + 1) // 2
+    k1 = k - k0
+    mw = np.array(
+        [max_block_weights[:k0].sum(), max_block_weights[k0:k].sum()], dtype=np.int64
+    )
+    bi = pool_bipartition(g, mw, rng, ctx)
+    for side, (kk, offset) in enumerate(((k0, 0), (k1, k0))):
+        sub, nodes = extract_subgraph(g, bi, side)
+        if kk > 1:
+            subpart = recursive_bipartition(
+                sub, kk, max_block_weights[offset : offset + kk], rng, ctx
+            )
+        else:
+            subpart = np.zeros(sub.n, dtype=np.int32)
+        part[nodes] = subpart + offset
+    return part

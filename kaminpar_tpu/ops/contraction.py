@@ -1,0 +1,113 @@
+"""Cluster contraction as a sort-reduce kernel.
+
+TPU-native counterpart of the reference's contraction algorithms
+(``kaminpar-shm/coarsening/contraction/`` — buffered / unbuffered two-pass
+with per-thread edge buffers, unbuffered_cluster_contraction.cc:35-70).  On
+TPU the whole thing is the classic sort-reduce (SURVEY §7 stage 4):
+
+1. relabel-compact cluster ids via presence scatter + prefix sum,
+2. map both edge endpoints to coarse ids, drop intra-cluster edges,
+3. sort edges by (coarse_u, coarse_v) and sum weights per run,
+4. compact runs to the front and build the coarse CSR.
+
+All device work uses static (fine-graph) shapes; the dynamically-sized coarse
+graph is extracted by the host with two scalar transfers (n_c, m_c) per level
+— the multilevel loop is host orchestration anyway (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.csr import CSRGraph
+
+
+@jax.jit
+def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
+    n = labels.shape[0]
+    m = col_idx.shape[0]
+    idt = labels.dtype
+
+    # 1. relabel-compact: cluster label -> dense coarse id
+    present = jnp.zeros(n, dtype=jnp.int32).at[labels].set(1)
+    cmap = (jnp.cumsum(present) - 1).astype(idt)
+    coarse_of = cmap[labels]
+    n_c = jnp.sum(present)
+
+    # coarse node weights (slots >= n_c are zero)
+    c_node_w = jax.ops.segment_sum(
+        node_w, coarse_of, num_segments=n
+    )
+
+    # 2./3. coarse edge aggregation
+    cu = coarse_of[edge_u]
+    cv = coarse_of[col_idx]
+    keep = cu != cv
+    ku = jnp.where(keep, cu, n)  # sentinel key sorts dropped edges last
+    kv = jnp.where(keep, cv, 0)
+    order = jnp.lexsort((kv, ku))
+    su, sv = ku[order], kv[order]
+    sw = jnp.where(keep[order], edge_w[order], 0)
+    first = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), (su[1:] != su[:-1]) | (sv[1:] != sv[:-1])]
+    )
+    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    run_w = jax.ops.segment_sum(sw, rid, num_segments=m)
+
+    # 4. compact valid runs to the front
+    valid = first & (su < n)
+    ridx = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    pos = jnp.where(valid, ridx, m)  # out-of-range drops
+    out_u = jnp.full(m, 0, dtype=idt).at[pos].set(su, mode="drop")
+    out_v = jnp.full(m, 0, dtype=idt).at[pos].set(sv, mode="drop")
+    out_w = jnp.zeros(m, dtype=edge_w.dtype).at[pos].set(run_w[rid], mode="drop")
+    m_c = jnp.sum(valid)
+
+    # coarse row_ptr over the full n-slot buffer (host slices to n_c+1)
+    deg_c = jax.ops.segment_sum(
+        valid.astype(jnp.int32), jnp.where(valid, su, 0).astype(jnp.int32), num_segments=n
+    )
+    # nodes with no kept edges still need zero-degree rows; segment over su
+    # only counts runs, and `where(valid, su, 0)` routes dropped runs to node 0
+    # with value 0, which is harmless.
+    row_ptr = jnp.concatenate(
+        [jnp.zeros(1, dtype=idt), jnp.cumsum(deg_c).astype(idt)]
+    )
+    return coarse_of, n_c, m_c, c_node_w, out_u, out_v, out_w, row_ptr
+
+
+def contract_clustering(graph: CSRGraph, labels_padded) -> Tuple[CSRGraph, jax.Array]:
+    """Contract a clustering of graph's nodes into a coarse graph.
+
+    ``labels_padded`` covers the graph's :class:`PaddedView` (pad nodes carry
+    the anchor label, forming one pure-padding cluster that is sliced off —
+    it is always the *last* coarse id since the anchor is the largest label).
+    Returns ``(coarse_graph, coarse_of)`` where ``coarse_of[u]`` is the coarse
+    node id of fine node ``u`` — the projection map used by uncoarsening
+    (reference: ``CoarseGraph::project_up``,
+    coarsening/abstract_cluster_coarsener.cc:148-170).
+    """
+    pv = graph.padded()
+    coarse_of, n_c, m_c, c_node_w, out_u, out_v, out_w, row_ptr = _contract_device(
+        jnp.asarray(labels_padded), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w
+    )
+    n_c = int(n_c) - 1  # drop the pure-padding anchor cluster (always last)
+    m_c = int(m_c)
+    idt = graph.row_ptr.dtype
+    coarse = CSRGraph(
+        row_ptr[: n_c + 1],
+        out_v[:m_c].astype(idt),
+        c_node_w[:n_c].astype(idt),
+        out_w[:m_c].astype(idt),
+    )
+    return coarse, coarse_of[: graph.n]
+
+
+@jax.jit
+def project_partition(coarse_of, coarse_partition):
+    """fine_partition[u] = coarse_partition[coarse_of[u]] — a single gather
+    (reference: uncoarsening projection, abstract_cluster_coarsener.cc:162)."""
+    return coarse_partition[coarse_of]

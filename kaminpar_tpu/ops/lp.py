@@ -1,0 +1,248 @@
+"""The label-propagation engine — THE hot path (north star).
+
+TPU-native re-design of the reference's CRTP LP template
+(``kaminpar-shm/label_propagation.h:83``; per-node kernel ``handle_node`` at
+:331 accumulating neighbor-cluster ratings into a hash map, CAS weight moves
+at :817-841).  Design per SURVEY §7 stage 3 / §2.8-2:
+
+- The racy *asynchronous* CPU LP becomes *synchronous* (Jacobi-style) rounds:
+  every node rates its neighbors' clusters against the labels from the start
+  of the round, then moves are committed in bulk.  This is a documented
+  semantic divergence; quality is recovered with random tie-breaking and more
+  rounds (and matches the reference's own distributed LP, which is already
+  bulk-synchronous per chunk, global_lp_clusterer.cc).
+- Rating accumulation is edge-parallel sort-reduce: sort CSR slots by
+  (source, neighbor-label), reduce runs — no hash maps, static shapes, and
+  high-degree nodes are handled *by construction* (their slots parallelize
+  like everyone else's), subsuming the reference's two-phase machinery
+  (label_propagation.h:571-601,640-815).
+- The weight-constraint CAS race (load-bearing for balance in the reference)
+  becomes a strict capacity auction: movers into each cluster are admitted in
+  random priority order while the round-start cluster weight plus the running
+  total stays within the limit — a deterministic, stricter variant of the
+  dist LP refiner's PROBABILISTIC commitment (dkaminpar.h:116-120).
+
+One engine serves both clustering (labels = cluster ids, num_labels = n, as
+lp_clusterer.cc instantiates it) and refinement (labels = block ids,
+num_labels = k, as lp_refiner.cc does).
+
+Everything is int32-clean (weights, ratings, indices), mirroring the
+reference's default 32-bit ID/weight build (CMakeLists.txt:71-79): total node
+and edge weight must stay below 2^31.  The 64-bit mode enables jax x64.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LPState(NamedTuple):
+    labels: jax.Array  # (n,) current label per node
+    label_weights: jax.Array  # (num_labels,) total node weight per label
+    num_moved: jax.Array  # () int32 — nodes moved in the last round
+
+
+def init_state(labels, node_w, num_labels: int) -> LPState:
+    label_weights = jax.ops.segment_sum(node_w, labels, num_segments=num_labels)
+    return LPState(jnp.asarray(labels), label_weights, jnp.int32(0))
+
+
+def _rate_and_select(key, labels, edge_u, col_idx, edge_w, node_w, label_weights, max_label_weights):
+    """Shared rating + feasibility + random-tie argmax.
+
+    Returns (desired, has_cand): per node, the best-rated feasible target
+    label and whether any candidate existed.  Three segment passes replace the
+    reference's per-thread rating hash maps (rating_map.h):
+    max score → max random tie among maxima → min slot among tie winners.
+    """
+    n = labels.shape[0]
+    m = col_idx.shape[0]
+
+    cand = labels[col_idx]
+    order = jnp.lexsort((cand, edge_u))
+    su = edge_u[order]
+    sc = cand[order]
+    sw = edge_w[order]
+
+    first = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), (su[1:] != su[:-1]) | (sc[1:] != sc[:-1])]
+    )
+    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    run_rating = jax.ops.segment_sum(sw, rid, num_segments=m)
+    rating = run_rating[rid]
+
+    w_u = node_w[su]
+    is_current = sc == labels[su]
+    fits = label_weights[sc] + w_u <= max_label_weights[sc]
+    feasible = first & (is_current | fits)
+
+    score = jnp.where(feasible, rating, -1)
+    best_score = jax.ops.segment_max(score, su, num_segments=n)
+    eligible = feasible & (rating == best_score[su])
+
+    tie = jax.random.randint(key, (m,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    tie_masked = jnp.where(eligible, tie, -1)
+    best_tie = jax.ops.segment_max(tie_masked, su, num_segments=n)
+    winner = eligible & (tie_masked == best_tie[su])
+
+    slot = jnp.arange(m, dtype=jnp.int32)
+    slot_masked = jnp.where(winner, slot, m)
+    best_slot = jax.ops.segment_min(slot_masked, su, num_segments=n)
+
+    has_cand = best_score > 0  # edge weights are >= 1, so any candidate rates > 0
+    safe_slot = jnp.clip(best_slot, 0, m - 1)
+    desired = jnp.where(has_cand, sc[safe_slot], labels)
+    return desired, has_cand
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def lp_round(
+    state: LPState,
+    key,
+    edge_u,
+    col_idx,
+    edge_w,
+    node_w,
+    max_label_weights,  # (num_labels,)
+    *,
+    num_labels: int,
+) -> LPState:
+    """One synchronous LP round; returns the updated state.
+
+    Equivalent work to one ``perform_iteration`` sweep of the reference
+    (label_propagation.h:1682) over all nodes.
+    """
+    labels, label_weights, _ = state
+    n = labels.shape[0]
+    kr, kp = jax.random.split(key)
+
+    desired, _ = _rate_and_select(
+        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights, max_label_weights
+    )
+    moved = desired != labels
+
+    # --- strict capacity auction over round-start weights -----------------
+    prio = jax.random.randint(kp, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    target = jnp.where(moved, desired, num_labels)  # sentinel for non-movers
+    order2 = jnp.lexsort((prio, target))
+    t_s = target[order2]
+    w_s = jnp.where(moved[order2], node_w[order2], 0)
+    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), t_s[1:] != t_s[:-1]])
+    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
+    cums = jnp.cumsum(w_s)
+    run_base = jax.ops.segment_max(
+        jnp.where(first2, cums - w_s, 0), rid2, num_segments=n
+    )
+    prefix = cums - run_base[rid2]
+    t_valid = t_s < num_labels
+    t_idx = jnp.where(t_valid, t_s, 0)
+    ok = t_valid & (label_weights[t_idx] + prefix <= max_label_weights[t_idx])
+    accept = jnp.zeros(n, dtype=bool).at[order2].set(ok)
+
+    commit = moved & accept
+    new_labels = jnp.where(commit, desired, labels)
+    new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
+    return LPState(new_labels, new_weights, jnp.sum(commit).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def cluster_isolated_nodes(
+    state: LPState,
+    row_ptr,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
+    """Group isolated (degree-0) nodes into max-weight-respecting clusters.
+
+    Reference: ``handle_isolated_nodes`` (label_propagation.h:872-917).  The
+    TPU version packs isolated nodes greedily by node order: running weight
+    total // max_weight yields a bucket id, the minimum node id per bucket
+    becomes the representative label.
+    """
+    labels, _, num_moved = state
+    n = labels.shape[0]
+    deg = row_ptr[1:] - row_ptr[:-1]
+    iso = (deg == 0) & (node_w > 0)  # weight-0 degree-0 nodes are shape padding
+    w = jnp.where(iso, node_w, 0)
+    cumw = jnp.cumsum(w)
+    cap = jnp.maximum(max_label_weights[0], 1)  # scalar limit for clustering
+    bucket = jnp.where(iso, jnp.clip((cumw - w) // cap, 0, n - 1), n)
+    bucket = bucket.astype(jnp.int32)
+    ids = jnp.arange(n, dtype=labels.dtype)
+    rep = jax.ops.segment_min(jnp.where(iso, ids, n), bucket, num_segments=n + 1)
+    new_labels = jnp.where(iso, rep[bucket].astype(labels.dtype), labels)
+    new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
+    return LPState(new_labels, new_weights, num_moved)
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def cluster_two_hop_nodes(
+    state: LPState,
+    key,
+    edge_u,
+    col_idx,
+    edge_w,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
+    """Match still-singleton clusters through their favored cluster.
+
+    Reference: two-hop clustering (label_propagation.h:919-1120): nodes that
+    could not join any cluster are grouped with *other singletons that favor
+    the same cluster* (two-hop neighbors).  TPU version: compute each
+    singleton's favored (max-rated, feasibility-ignored) cluster, sort
+    singletons by favored cluster, and merge odd run positions into the
+    preceding slot's cluster subject to the weight limit.
+    """
+    labels, label_weights, num_moved = state
+    n = labels.shape[0]
+    m = col_idx.shape[0]
+    kr, kp = jax.random.split(key)
+
+    # Singleton = node alone in its own cluster.
+    cluster_sizes = jax.ops.segment_sum(
+        jnp.ones(n, dtype=jnp.int32), labels, num_segments=num_labels
+    )
+    singleton = (labels == jnp.arange(n, dtype=labels.dtype)) & (
+        cluster_sizes[labels] == 1
+    )
+
+    # Favored cluster: plain rating argmax with no weight constraint — reuse
+    # the selector with infinite capacity.
+    inf_cap = jnp.full_like(max_label_weights, jnp.iinfo(jnp.int32).max)
+    favored, has = _rate_and_select(
+        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights, inf_cap
+    )
+
+    # Pair up singletons that favor the same cluster: sort by favored id and
+    # merge odd positions into the preceding even position's cluster.
+    fkey = jnp.where(singleton & has, favored, n)  # sentinel: not eligible
+    prio = jax.random.randint(kp, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    order2 = jnp.lexsort((prio, fkey))
+    f_s = fkey[order2]
+    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), f_s[1:] != f_s[:-1]])
+    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
+    starts = jax.ops.segment_max(
+        jnp.where(first2, jnp.arange(n, dtype=jnp.int32), 0), rid2, num_segments=n
+    )
+    pos_in_run = jnp.arange(n, dtype=jnp.int32) - starts[rid2]
+    prev_node = jnp.concatenate([order2[:1], order2[:-1]])
+    partner_label = labels[prev_node]
+    valid = (f_s < n) & (pos_in_run % 2 == 1)
+    w_s = node_w[order2]
+    w_prev = jnp.concatenate([w_s[:1], w_s[:-1]])
+    fits = w_s + w_prev <= max_label_weights[0]
+    merge = valid & fits
+    new_labels = labels.at[order2].set(
+        jnp.where(merge, partner_label, labels[order2])
+    )
+    new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
+    return LPState(new_labels, new_weights, num_moved)

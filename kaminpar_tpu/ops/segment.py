@@ -1,0 +1,54 @@
+"""Sort-reduce / reduce-by-key primitives — the universal TPU substrate.
+
+Everything the reference implements with per-thread hash maps
+(``RatingMap``/``FastResetArray``, kaminpar-common/datastructures/rating_map.h)
+becomes, on TPU, a *sort by key + segmented reduction* over flat edge arrays:
+dynamic hashing does not map to XLA, but an O(m log m) bitonic sort plus O(m)
+scans/scatters does, with fully static shapes.  These helpers are shared by
+the LP engine (ops/lp.py) and contraction (ops/contraction.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def run_starts(sorted_key) -> jax.Array:
+    """Boolean mask marking the first slot of every run of equal keys in a
+    sorted array."""
+    m = sorted_key.shape[0]
+    if m == 0:
+        return jnp.zeros(0, dtype=bool)
+    return jnp.concatenate(
+        [jnp.ones(1, dtype=bool), sorted_key[1:] != sorted_key[:-1]]
+    )
+
+
+def run_ids(first_mask) -> jax.Array:
+    """Dense run index per slot: [0, #runs)."""
+    return jnp.cumsum(first_mask.astype(jnp.int32)) - 1
+
+
+def reduce_runs(values, run_id, num_slots: int):
+    """Sum `values` within each run (run_id from :func:`run_ids`).
+
+    Returns an array of length ``num_slots`` (upper bound on #runs); entries
+    past the last run are zero.
+    """
+    return jax.ops.segment_sum(values, run_id, num_segments=num_slots)
+
+
+def segment_prefix_sum(values, first_mask):
+    """Inclusive prefix sum of `values` restarting at every run start.
+
+    For slots sorted by key: within-run running total, used for strict
+    capacity-respecting move acceptance (the TPU stand-in for the reference's
+    CAS loop at label_propagation.h:817-841).
+    """
+    cums = jnp.cumsum(values)
+    # Value of the global cumsum just *before* each run begins.
+    before = jnp.where(first_mask, cums - values, 0)
+    rid = run_ids(first_mask)
+    run_base = jax.ops.segment_max(before, rid, num_segments=values.shape[0])
+    return cums - run_base[rid]

@@ -1,0 +1,114 @@
+"""Deep multilevel partitioning — the flagship scheme.
+
+Reference: ``kaminpar-shm/partitioning/deep/deep_multilevel.cc`` (ESA'21):
+``partition() = uncoarsen(initial_partition(coarsen()))`` (:66) — coarsen
+until ``n <= 2C`` (:170-183), bipartition-pool the coarsest graph into a small
+k0, then uncoarsen: project, refine, and *extend* the partition
+(``extend_partition``, helper.cc:349) by recursively bipartitioning block
+subgraphs until the level carries ``compute_k_for_n(n)`` blocks (:296-305),
+reaching the final k on the finest levels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..coarsening.cluster_coarsener import ClusterCoarsener
+from ..context import Context
+from ..factories import create_refiner
+from ..graph.csr import CSRGraph
+from ..graph.partitioned import PartitionedGraph
+from ..initial.bipartitioner import extract_subgraph, recursive_bipartition
+from ..utils import RandomState
+from ..utils.logger import Logger, OutputLevel
+from ..utils.timer import scoped_timer
+from .kway import graph_to_host
+from .partition_utils import compute_k_for_n, intermediate_block_weights, split_offsets
+
+
+def extend_partition(
+    graph: CSRGraph, part: np.ndarray, cur_k: int, new_k: int, ctx: Context
+) -> np.ndarray:
+    """Split every block of a cur_k-way partition so the result has new_k
+    blocks (reference: ``extend_partition``, partitioning/helper.cc:349 —
+    extract block subgraphs, bipartition each recursively).  Host-side; the
+    per-block subgraphs are small relative to the full graph."""
+    final_bw = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
+    off_new = split_offsets(len(final_bw), new_k)
+    off = split_offsets(new_k, cur_k)  # block b -> new blocks [off[b], off[b+1])
+    host = graph_to_host(graph)
+    rng = RandomState.numpy_rng()
+    out = np.zeros(graph.n, dtype=np.int32)
+    for b in range(cur_k):
+        lo, hi = int(off[b]), int(off[b + 1])
+        sub_k = hi - lo
+        sub, nodes = extract_subgraph(host, part, b)
+        if sub_k <= 1:
+            out[nodes] = lo
+            continue
+        # budgets of the new blocks = sums of their final budgets
+        budgets = np.array(
+            [final_bw[off_new[j] : off_new[j + 1]].sum() for j in range(lo, hi)],
+            dtype=np.int64,
+        )
+        subpart = recursive_bipartition(sub, sub_k, budgets, rng, ctx.initial_partitioning)
+        out[nodes] = subpart + lo
+    return out
+
+
+class DeepMultilevelPartitioner:
+    def __init__(self, ctx: Context, graph: CSRGraph):
+        self.ctx = ctx
+        self.graph = graph
+
+    def _refine(self, graph: CSRGraph, part, cur_k: int, coarse: bool) -> PartitionedGraph:
+        max_bw = intermediate_block_weights(
+            np.asarray(self.ctx.partition.max_block_weights, dtype=np.int64), cur_k
+        )
+        p_graph = PartitionedGraph.create(graph, cur_k, part, max_bw)
+        refiner = create_refiner(self.ctx, coarse_level=coarse)
+        return refiner.refine(p_graph)
+
+    def partition(self) -> PartitionedGraph:
+        ctx = self.ctx
+        k = ctx.partition.k
+        C = ctx.coarsening.contraction_limit
+        coarsener = ClusterCoarsener(ctx, self.graph)
+
+        with scoped_timer("partitioning"):
+            coarsest = coarsener.coarsen(k, ctx.partition.epsilon, 2 * C)
+            cur_k = min(k, compute_k_for_n(coarsest.n, C, k))
+            Logger.log(
+                f"  deep: coarsest n={coarsest.n} m={coarsest.m} "
+                f"levels={coarsener.num_levels} k0={cur_k}",
+                OutputLevel.DEBUG,
+            )
+
+            host = graph_to_host(coarsest)
+            rng = RandomState.numpy_rng()
+            budgets = intermediate_block_weights(
+                np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
+            )
+            with scoped_timer("initial_partitioning"):
+                part = recursive_bipartition(
+                    host, cur_k, budgets, rng, ctx.initial_partitioning
+                )
+            p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
+
+            while True:
+                graph = coarsener.current_graph
+                target_k = compute_k_for_n(graph.n, C, k) if coarsener.num_levels > 0 else k
+                if cur_k < target_k:
+                    part = extend_partition(
+                        graph, np.asarray(p_graph.partition), cur_k, target_k, ctx
+                    )
+                    cur_k = target_k
+                    p_graph = self._refine(graph, part, cur_k, coarsener.num_levels > 0)
+                if coarsener.num_levels == 0:
+                    break
+                fine_part = coarsener.uncoarsen(p_graph.partition)
+                p_graph = self._refine(
+                    coarsener.current_graph, fine_part, cur_k, coarsener.num_levels > 0
+                )
+
+        return p_graph

@@ -1,0 +1,84 @@
+"""Classic single-shot k-way multilevel partitioning.
+
+Reference: ``kaminpar-shm/partitioning/kway/kway_multilevel.cc`` — coarsen
+until ``n <= contraction_limit * k``, compute a direct k-way initial
+partition on the coarsest graph, then uncoarsen with refinement on every
+level.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..coarsening.cluster_coarsener import ClusterCoarsener
+from ..context import Context
+from ..factories import create_refiner
+from ..graph.csr import CSRGraph
+from ..graph.partitioned import PartitionedGraph
+from ..initial.bipartitioner import HostCSR, recursive_bipartition
+from ..utils import RandomState
+from ..utils.logger import Logger, OutputLevel
+from ..utils.timer import scoped_timer
+
+
+def graph_to_host(graph: CSRGraph) -> HostCSR:
+    return HostCSR(
+        np.asarray(graph.row_ptr).astype(np.int64),
+        np.asarray(graph.col_idx).astype(np.int64),
+        np.asarray(graph.node_w).astype(np.int64),
+        np.asarray(graph.edge_w).astype(np.int64),
+    )
+
+
+def initial_partition(graph: CSRGraph, ctx: Context) -> np.ndarray:
+    """k-way initial partition of the coarsest graph via recursive bisection
+    on host (SURVEY §7 stage 5: the reference is sequential here too)."""
+    host = graph_to_host(graph)
+    rng = RandomState.numpy_rng()
+    with scoped_timer("initial_partitioning"):
+        return recursive_bipartition(
+            host,
+            ctx.partition.k,
+            np.asarray(ctx.partition.max_block_weights, dtype=np.int64),
+            rng,
+            ctx.initial_partitioning,
+        )
+
+
+class KWayMultilevelPartitioner:
+    def __init__(self, ctx: Context, graph: CSRGraph):
+        self.ctx = ctx
+        self.graph = graph
+
+    def partition(self) -> PartitionedGraph:
+        ctx = self.ctx
+        k = ctx.partition.k
+        coarsener = ClusterCoarsener(ctx, self.graph)
+        target_n = max(ctx.coarsening.contraction_limit * k, 2 * ctx.coarsening.contraction_limit)
+
+        with scoped_timer("partitioning"):
+            coarsest = coarsener.coarsen(k, ctx.partition.epsilon, target_n)
+            Logger.log(
+                f"  coarsest graph: n={coarsest.n} m={coarsest.m} "
+                f"({coarsener.num_levels} levels)",
+                OutputLevel.DEBUG,
+            )
+
+            part = initial_partition(coarsest, ctx)
+            p_graph = PartitionedGraph.create(
+                coarsest, k, part, ctx.partition.max_block_weights
+            )
+
+            refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
+            p_graph = refiner.refine(p_graph)
+
+            while coarsener.num_levels > 0:
+                fine_part = coarsener.uncoarsen(p_graph.partition)
+                fine_graph = coarsener.current_graph
+                p_graph = PartitionedGraph.create(
+                    fine_graph, k, fine_part, ctx.partition.max_block_weights
+                )
+                refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
+                p_graph = refiner.refine(p_graph)
+
+        return p_graph

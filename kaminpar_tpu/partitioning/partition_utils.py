@@ -1,0 +1,47 @@
+"""Deep-multilevel k arithmetic.
+
+Reference: ``kaminpar-shm/partitioning/partition_utils.cc:138``
+(``compute_k_for_n``, ``compute_final_k``): on the way up, the partition is
+extended so that a graph with n nodes carries ``min(k, 2^floor(log2(n/C)))``
+blocks; each intermediate block b is responsible for a contiguous range of
+final blocks whose budgets sum to its intermediate budget.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def compute_k_for_n(n: int, contraction_limit: int, k: int) -> int:
+    if n <= 2 * contraction_limit:
+        return 2
+    kk = 1 << int(math.floor(math.log2(max(n / contraction_limit, 2.0))))
+    return int(min(max(kk, 2), k))
+
+
+def split_counts(k: int, cur_k: int) -> np.ndarray:
+    """How many final blocks each of the cur_k intermediate blocks becomes
+    (reference: ``compute_final_k``) — k distributed as evenly as possible."""
+    base = k // cur_k
+    counts = np.full(cur_k, base, dtype=np.int64)
+    counts[: k % cur_k] += 1
+    return counts
+
+
+def split_offsets(k: int, cur_k: int) -> np.ndarray:
+    counts = split_counts(k, cur_k)
+    off = np.zeros(cur_k + 1, dtype=np.int64)
+    np.cumsum(counts, out=off[1:])
+    return off
+
+
+def intermediate_block_weights(final_max_bw: np.ndarray, cur_k: int) -> np.ndarray:
+    """Intermediate block budgets = sums of the final budgets each block will
+    be split into (so imbalance does not accumulate through extension)."""
+    k = len(final_max_bw)
+    off = split_offsets(k, cur_k)
+    return np.array(
+        [final_max_bw[off[b] : off[b + 1]].sum() for b in range(cur_k)], dtype=np.int64
+    )

@@ -1,0 +1,70 @@
+"""Recursive bipartitioning multilevel scheme.
+
+Reference: ``kaminpar-shm/partitioning/rb/rb_multilevel.cc`` — partition into
+k by recursive bisection, where every bisection is a full multilevel run
+(coarsen → bipartition → refine) on the subgraph.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..context import Context, PartitioningMode
+from ..graph.csr import CSRGraph, from_numpy_csr
+from ..graph.partitioned import PartitionedGraph
+from ..initial.bipartitioner import HostCSR, extract_subgraph
+from ..utils.timer import scoped_timer
+
+
+class RBMultilevelPartitioner:
+    def __init__(self, ctx: Context, graph: CSRGraph):
+        self.ctx = ctx
+        self.graph = graph
+
+    def _bisect(self, graph: CSRGraph, max_bw: np.ndarray) -> np.ndarray:
+        from .kway import KWayMultilevelPartitioner
+
+        sub_ctx = copy.deepcopy(self.ctx)
+        sub_ctx.mode = PartitioningMode.KWAY
+        sub_ctx.partition.k = 2
+        sub_ctx.partition.max_block_weights = max_bw
+        p = KWayMultilevelPartitioner(sub_ctx, graph).partition()
+        return np.asarray(p.partition)
+
+    def _recurse(self, graph: CSRGraph, k: int, max_bw: np.ndarray) -> np.ndarray:
+        if k <= 1 or graph.n == 0:
+            return np.zeros(graph.n, dtype=np.int32)
+        k0 = (k + 1) // 2
+        k1 = k - k0
+        budgets = np.array([max_bw[:k0].sum(), max_bw[k0:].sum()], dtype=np.int64)
+        bi = self._bisect(graph, budgets)
+        part = np.zeros(graph.n, dtype=np.int32)
+        host = HostCSR(
+            np.asarray(graph.row_ptr).astype(np.int64),
+            np.asarray(graph.col_idx).astype(np.int64),
+            np.asarray(graph.node_w).astype(np.int64),
+            np.asarray(graph.edge_w).astype(np.int64),
+        )
+        for side, (kk, offset) in enumerate(((k0, 0), (k1, k0))):
+            sub, nodes = extract_subgraph(host, bi, side)
+            if kk > 1:
+                subgraph = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
+                subpart = self._recurse(subgraph, kk, max_bw[offset : offset + kk])
+            else:
+                subpart = np.zeros(sub.n, dtype=np.int32)
+            part[nodes] = subpart + offset
+        return part
+
+    def partition(self) -> PartitionedGraph:
+        ctx = self.ctx
+        with scoped_timer("partitioning"):
+            part = self._recurse(
+                self.graph,
+                ctx.partition.k,
+                np.asarray(ctx.partition.max_block_weights, dtype=np.int64),
+            )
+        return PartitionedGraph.create(
+            self.graph, ctx.partition.k, part, ctx.partition.max_block_weights
+        )

@@ -1,0 +1,117 @@
+"""Named presets constructing fully-populated :class:`Context` trees.
+
+Mirrors the reference's preset ladder (``kaminpar-shm/presets.cc:109,452-691``;
+speed/quality ordering fast < default < eco < strong, README.MD:184-190).  The
+reference has 17 presets; we provide the core ladder plus noref/jet and grow
+the list as components land.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from .context import (
+    ClusteringAlgorithm,
+    Context,
+    LabelPropagationContext,
+    PartitioningMode,
+    RefinementAlgorithm,
+)
+
+
+def create_default_context() -> Context:
+    """Reference: ``create_default_context`` (presets.cc:109): LP coarsening,
+    greedy balancer + LP refinement, deep scheme."""
+    ctx = Context(preset_name="default")
+    ctx.mode = PartitioningMode.DEEP
+    ctx.refinement.algorithms = (
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.LP,
+    )
+    return ctx
+
+
+def create_fast_context() -> Context:
+    """Reference: ``create_fast_context``: fewer LP iterations, fast IP."""
+    ctx = create_default_context()
+    ctx.preset_name = "fast"
+    ctx.coarsening.lp.num_iterations = 1
+    ctx.refinement.lp.num_iterations = 2
+    ctx.initial_partitioning.min_num_repetitions = 1
+    ctx.initial_partitioning.max_num_repetitions = 2
+    return ctx
+
+
+def create_strong_context() -> Context:
+    """Reference eco/strong presets add FM; our TPU-native quality refiner is
+    JET (SURVEY §7 stage 7) layered on top of balancer + LP."""
+    ctx = create_default_context()
+    ctx.preset_name = "strong"
+    ctx.refinement.algorithms = (
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.LP,
+        RefinementAlgorithm.JET,
+    )
+    return ctx
+
+
+def create_jet_context() -> Context:
+    """Reference: ``create_jet_context`` (presets.cc): JET as the only
+    refiner (plus balancing, which JET invokes internally)."""
+    ctx = create_default_context()
+    ctx.preset_name = "jet"
+    ctx.refinement.algorithms = (RefinementAlgorithm.JET,)
+    return ctx
+
+
+def create_noref_context() -> Context:
+    """Reference: ``create_noref_context``: no refinement at all."""
+    ctx = create_default_context()
+    ctx.preset_name = "noref"
+    ctx.refinement.algorithms = ()
+    return ctx
+
+
+def create_largek_context() -> Context:
+    """Reference: ``create_largek_context``: tuned for k > 1024 — smaller
+    contraction limit per block."""
+    ctx = create_default_context()
+    ctx.preset_name = "largek"
+    ctx.coarsening.contraction_limit = 640
+    return ctx
+
+
+def create_kway_context() -> Context:
+    """Classic single-shot k-way multilevel (reference: mtkahypar-kway
+    preset / partitioning/kway/kway_multilevel.cc)."""
+    ctx = create_default_context()
+    ctx.preset_name = "kway"
+    ctx.mode = PartitioningMode.KWAY
+    return ctx
+
+
+_PRESETS = {
+    "default": create_default_context,
+    "fast": create_fast_context,
+    "strong": create_strong_context,
+    "eco": create_strong_context,  # until flow/FM-class refiners land
+    "jet": create_jet_context,
+    "noref": create_noref_context,
+    "largek": create_largek_context,
+    "kway": create_kway_context,
+}
+
+
+def create_context_by_preset_name(name: str) -> Context:
+    """Reference: ``create_context_by_preset_name`` (presets.cc)."""
+    try:
+        ctx = _PRESETS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown preset '{name}'; available: {sorted(_PRESETS)}"
+        ) from None
+    return copy.deepcopy(ctx)
+
+
+def get_preset_names() -> list:
+    return sorted(_PRESETS)

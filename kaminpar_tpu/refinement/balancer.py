@@ -1,0 +1,118 @@
+"""Overload balancer: push weight out of overloaded blocks by relative gain.
+
+Reference: ``kaminpar-shm/refinement/balancer/overload_balancer.cc:34-60`` —
+per overloaded block, a PQ of moves ordered by relative gain pushes weight out
+until the block is feasible.  The TPU version runs bulk-synchronous rounds:
+
+1. every node in an overloaded block computes its best feasible external
+   target (highest connection; fallback: the globally lightest block),
+2. per *source* block, movers are admitted in decreasing relative-gain order
+   until the overload is covered (sort + segmented prefix sum),
+3. per *target* block, admitted movers pass a strict capacity auction
+   (same pattern as ops/lp.py) so no receiver becomes overloaded.
+
+Rounds repeat until feasible or the round budget is exhausted.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..context import BalancerContext
+from ..graph.partitioned import PartitionedGraph
+from ..ops.gains import best_moves
+from ..utils import next_key
+from ..utils.timer import scoped_timer
+from .refiner import Refiner
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _balance_round(key, labels, edge_u, col_idx, edge_w, node_w, max_bw, *, k: int):
+    n = labels.shape[0]
+    kb, ks, kt = jax.random.split(key, 3)
+    block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
+
+    target, tconn, oconn, has = best_moves(
+        kb, labels, edge_u, col_idx, edge_w, node_w, block_weights, max_bw,
+        num_labels=k, external_only=True, respect_caps=True,
+    )
+
+    overloaded = block_weights > max_bw
+    mover = overloaded[labels] & (node_w > 0)  # weight-0 nodes are shape padding
+
+    # Fallback for movers with no adjacent feasible target: lightest block.
+    light = jnp.argmin(block_weights)
+    fallback_ok = block_weights[light] + node_w <= max_bw[light]
+    use_fb = mover & ~has & fallback_ok & (labels != light)
+    target = jnp.where(use_fb, light, target)
+    tconn = jnp.where(use_fb, 0, tconn)
+    eligible = mover & (has | use_fb)
+
+    gain = tconn - oconn
+    # Relative gain orders cheap high-gain moves first (reference scales gain
+    # by node weight; a float ratio gives the same ordering intent).
+    rel = gain.astype(jnp.float32) / jnp.maximum(node_w, 1).astype(jnp.float32)
+    jitter = jax.random.uniform(ks, (n,), minval=0.0, maxval=1e-3)
+    rel = rel + jitter
+
+    # --- source-side admission: cover each block's overload ---------------
+    src = jnp.where(eligible, labels, k)
+    order = jnp.lexsort((-rel, src))
+    s_s = src[order]
+    w_s = jnp.where(eligible[order], node_w[order], 0)
+    first = jnp.concatenate([jnp.ones(1, dtype=bool), s_s[1:] != s_s[:-1]])
+    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    cums = jnp.cumsum(w_s)
+    run_base = jax.ops.segment_max(jnp.where(first, cums - w_s, 0), rid, num_segments=n)
+    prefix_excl = cums - run_base[rid] - w_s
+    s_valid = s_s < k
+    s_idx = jnp.where(s_valid, s_s, 0)
+    overload = jnp.maximum(block_weights - max_bw, 0)
+    keep_src = s_valid & (prefix_excl < overload[s_idx])
+    src_ok = jnp.zeros(n, dtype=bool).at[order].set(keep_src)
+
+    # --- target-side capacity auction -------------------------------------
+    admitted = eligible & src_ok
+    tgt = jnp.where(admitted, target, k)
+    order2 = jnp.lexsort((-rel, tgt))
+    t_s = tgt[order2]
+    w_t = jnp.where(admitted[order2], node_w[order2], 0)
+    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), t_s[1:] != t_s[:-1]])
+    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
+    cums2 = jnp.cumsum(w_t)
+    run_base2 = jax.ops.segment_max(jnp.where(first2, cums2 - w_t, 0), rid2, num_segments=n)
+    prefix2 = cums2 - run_base2[rid2]
+    t_valid = t_s < k
+    t_idx = jnp.where(t_valid, t_s, 0)
+    keep_tgt = t_valid & (block_weights[t_idx] + prefix2 <= max_bw[t_idx])
+    tgt_ok = jnp.zeros(n, dtype=bool).at[order2].set(keep_tgt)
+
+    commit = admitted & tgt_ok
+    new_labels = jnp.where(commit, target, labels)
+    new_bw = jax.ops.segment_sum(node_w, new_labels, num_segments=k)
+    still_overloaded = jnp.any(new_bw > max_bw)
+    return new_labels, jnp.sum(commit).astype(jnp.int32), still_overloaded
+
+
+class OverloadBalancer(Refiner):
+    def __init__(self, ctx: BalancerContext):
+        self.ctx = ctx
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        pv = p_graph.graph.padded()
+        max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        labels = pv.pad_node_array(p_graph.partition, 0)
+        with scoped_timer("overload_balancer"):
+            for _ in range(self.ctx.max_num_rounds):
+                labels, num_moved, still = _balance_round(
+                    next_key(), labels, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+                    max_bw, k=p_graph.k,
+                )
+                if not bool(still):
+                    break
+                if int(num_moved) == 0:
+                    break  # stuck: no feasible moves (cluster balancer territory)
+        return p_graph.with_partition(labels[: pv.n])

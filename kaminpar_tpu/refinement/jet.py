@@ -1,0 +1,111 @@
+"""JET refiner: filtered bulk moves with best-snapshot rollback.
+
+Reference: ``kaminpar-shm/refinement/jet/jet_refiner.cc`` (Gilbert et al.'s
+GPU algorithm — already bulk-synchronous, hence the designated TPU-native
+quality refiner per SURVEY §7 stage 7).  Per iteration:
+
+1. **Find** (jet_refiner.cc:104-132): every unlocked border node picks its
+   best external block by gain, kept as a candidate if
+   ``gain > -floor(temp * conn(u, from))`` — the temperature admits negative
+   moves to escape local minima.
+2. **Filter** (:135-170): candidate u re-evaluates its gain under the
+   assumption that every candidate neighbor v with higher priority
+   (``gain_v > gain_u`` or equal and ``v < u``) executes its move; u stays a
+   candidate only if this pessimistic gain is positive.  On TPU this is one
+   edge-parallel masked segment-sum — no sort needed.
+3. **Execute** moves unconditionally (may violate balance), **rebalance**
+   with the overload balancer, and snapshot the best feasible partition
+   (:173-199).  Locked (= just moved) nodes sit out the next find phase.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..context import BalancerContext, JetContext
+from ..graph.partitioned import PartitionedGraph
+from ..ops.gains import best_moves
+from ..utils import next_key
+from ..utils.timer import scoped_timer
+from .balancer import OverloadBalancer
+from .refiner import Refiner
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _jet_move_round(key, labels, locked, edge_u, col_idx, edge_w, node_w, max_bw, temp, *, k: int):
+    n = labels.shape[0]
+    block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
+
+    # --- find -------------------------------------------------------------
+    target, tconn, oconn, has = best_moves(
+        key, labels, edge_u, col_idx, edge_w, node_w, block_weights, max_bw,
+        num_labels=k, external_only=True, respect_caps=False,
+    )
+    gain = tconn - oconn
+    threshold = -jnp.floor(temp * oconn.astype(jnp.float32)).astype(gain.dtype)
+    cand = has & ~locked & (gain > threshold)
+
+    # --- filter (edge-parallel pessimistic gain) --------------------------
+    gu = gain[edge_u]
+    gv = gain[col_idx]
+    v_cand = cand[col_idx]
+    v_before = v_cand & ((gv > gu) | ((gv == gu) & (col_idx < edge_u)))
+    eff_v = jnp.where(v_before, target[col_idx], labels[col_idx])
+    to_u = target[edge_u]
+    from_u = labels[edge_u]
+    contrib = jnp.where(eff_v == to_u, edge_w, 0) - jnp.where(eff_v == from_u, edge_w, 0)
+    gain2 = jax.ops.segment_sum(jnp.where(cand[edge_u], contrib, 0), edge_u, num_segments=n)
+    move = cand & (gain2 > 0)
+
+    new_labels = jnp.where(move, target, labels)
+    return new_labels, move
+
+
+class JetRefiner(Refiner):
+    def __init__(self, ctx: JetContext, balancer_ctx: BalancerContext, *, coarse_level: bool = False):
+        self.ctx = ctx
+        self.balancer = OverloadBalancer(balancer_ctx)
+        self.coarse_level = coarse_level
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        pv = p_graph.graph.padded()
+        k = p_graph.k
+        ctx = self.ctx
+        max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        temp = (
+            ctx.initial_gain_temp_on_coarse_level
+            if self.coarse_level
+            else ctx.initial_gain_temp_on_fine_level
+        )
+
+        p_graph = self.balancer.refine(p_graph)
+        best = p_graph
+        best_cut = p_graph.edge_cut()
+        labels = pv.pad_node_array(p_graph.partition, 0)
+        locked = jnp.zeros(pv.n_pad, dtype=bool)
+        fruitless = 0
+
+        with scoped_timer("jet_refinement"):
+            for _ in range(ctx.num_iterations):
+                labels, moved = _jet_move_round(
+                    next_key(), labels, locked, pv.edge_u, pv.col_idx, pv.edge_w,
+                    pv.node_w, max_bw, jnp.float32(temp), k=k,
+                )
+                locked = moved
+                cur = self.balancer.refine(p_graph.with_partition(labels[: pv.n]))
+                labels = pv.pad_node_array(cur.partition, 0)
+                cut = cur.edge_cut()
+                if cut <= best_cut and cur.is_feasible():
+                    if best_cut - cut > (1.0 - ctx.fruitless_threshold) * best_cut:
+                        fruitless = 0
+                    else:
+                        fruitless += 1
+                    best, best_cut = cur, cut
+                else:
+                    fruitless += 1
+                if fruitless >= self.ctx.num_fruitless_iterations:
+                    break
+        return best

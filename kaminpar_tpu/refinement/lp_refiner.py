@@ -1,0 +1,47 @@
+"""LP refiner: the LP engine with blocks as clusters.
+
+Reference: ``kaminpar-shm/refinement/lp/lp_refiner.cc`` — instantiates the
+shared LP engine with ClusterID = BlockID, so nodes greedily move to the
+adjacent block with maximal connection weight subject to the block weight
+limits.  Here this is literally the same jitted round as coarsening LP with
+``num_labels = k`` (SURVEY §7 stage 6).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..context import LabelPropagationContext
+from ..graph.partitioned import PartitionedGraph
+from ..ops import lp
+from ..utils import next_key
+from ..utils.timer import scoped_timer
+from .refiner import Refiner
+
+
+class LPRefiner(Refiner):
+    def __init__(self, ctx: LabelPropagationContext):
+        self.ctx = ctx
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        pv = p_graph.graph.padded()
+        k = p_graph.k
+        part = pv.pad_node_array(p_graph.partition, 0)  # pads are inert (w=0)
+        state = lp.init_state(part, pv.node_w, k)
+        max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+
+        with scoped_timer("lp_refinement"):
+            for _ in range(self.ctx.num_iterations):
+                state = lp.lp_round(
+                    state,
+                    next_key(),
+                    pv.edge_u,
+                    pv.col_idx,
+                    pv.edge_w,
+                    pv.node_w,
+                    max_w,
+                    num_labels=k,
+                )
+                if int(state.num_moved) <= self.ctx.min_moved_fraction * pv.n:
+                    break
+        return p_graph.with_partition(state.labels[: pv.n])

@@ -1,0 +1,32 @@
+"""Refiner interface + pipeline composition.
+
+Reference: ``kaminpar-shm/refinement/refiner.h`` (``Refiner::{initialize,
+refine}``) and ``multi_refiner.cc`` — presets define an ordered pipeline of
+refiners run on every uncoarsening level (factories.cc:97-147).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..graph.partitioned import PartitionedGraph
+
+
+class Refiner:
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        raise NotImplementedError
+
+
+class MultiRefiner(Refiner):
+    def __init__(self, refiners: Sequence[Refiner]):
+        self.refiners = list(refiners)
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        for r in self.refiners:
+            p_graph = r.refine(p_graph)
+        return p_graph
+
+
+class NoopRefiner(Refiner):
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        return p_graph

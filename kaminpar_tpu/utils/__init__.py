@@ -1,0 +1,14 @@
+from .logger import Logger, OutputLevel, log_result_line
+from .rng import RandomState, next_key, reseed
+from .timer import Timer, scoped_timer
+
+__all__ = [
+    "Logger",
+    "OutputLevel",
+    "log_result_line",
+    "RandomState",
+    "next_key",
+    "reseed",
+    "Timer",
+    "scoped_timer",
+]

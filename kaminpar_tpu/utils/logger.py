@@ -1,0 +1,50 @@
+"""Logger with verbosity ladder and the parseable ``RESULT`` line.
+
+Mirrors the reference's ``Logger`` (``kaminpar-common/logger.h:34-50``) and
+``OutputLevel::{QUIET..DEBUG}`` (kaminpar.h:849-855).  The single-line
+``RESULT cut=... imbalance=... feasible=... k=...`` record
+(kaminpar-shm/kaminpar.cc:48) is the de-facto experiment interface and is
+reproduced byte-compatibly by :func:`log_result_line`.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+
+
+class OutputLevel(enum.IntEnum):
+    QUIET = 0
+    PROGRESS = 1
+    APPLICATION = 2
+    EXPERIMENT = 3
+    DEBUG = 4
+
+
+class Logger:
+    level: OutputLevel = OutputLevel.APPLICATION
+    stream = sys.stdout
+
+    @classmethod
+    def log(cls, msg: str, level: OutputLevel = OutputLevel.APPLICATION) -> None:
+        if cls.level >= level:
+            print(msg, file=cls.stream, flush=True)
+
+    @classmethod
+    def warning(cls, msg: str) -> None:
+        if cls.level > OutputLevel.QUIET:
+            print(f"[Warning] {msg}", file=sys.stderr, flush=True)
+
+    @classmethod
+    def error(cls, msg: str) -> None:
+        print(f"[Error] {msg}", file=sys.stderr, flush=True)
+
+
+def log_result_line(cut: int, imbalance: float, feasible: bool, k: int, seconds: float) -> str:
+    """Reference: kaminpar-shm/kaminpar.cc:48."""
+    line = (
+        f"RESULT cut={int(cut)} imbalance={imbalance} feasible={int(feasible)} "
+        f"k={int(k)} time={seconds}"
+    )
+    Logger.log(line, OutputLevel.EXPERIMENT)
+    return line

@@ -1,0 +1,51 @@
+"""Seeded PRNG key management.
+
+The reference keeps thread-local ``std::mt19937`` singletons with a global
+reseed (``kaminpar-common/random.h:27-60``).  In JAX the idiomatic equivalent
+is functional key threading; this module provides a tiny global key-chain so
+host-side orchestration code can draw fresh keys deterministically from one
+seed, matching ``Random::reseed``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class RandomState:
+    _key = None
+    _seed = 0
+
+    @classmethod
+    def reseed(cls, seed: int) -> None:
+        cls._seed = int(seed)
+        cls._key = jax.random.key(int(seed))
+
+    @classmethod
+    def seed(cls) -> int:
+        return cls._seed
+
+    @classmethod
+    def next_key(cls):
+        if cls._key is None:
+            cls.reseed(0)
+        cls._key, sub = jax.random.split(cls._key)
+        return sub
+
+    @classmethod
+    def numpy_rng(cls) -> np.random.Generator:
+        """Host-side RNG for the sequential initial partitioner, derived from
+        the same seed chain."""
+        if cls._key is None:
+            cls.reseed(0)
+        data = jax.random.key_data(cls.next_key())
+        return np.random.default_rng(np.asarray(data).astype(np.uint32))
+
+
+def reseed(seed: int) -> None:
+    RandomState.reseed(seed)
+
+
+def next_key():
+    return RandomState.next_key()

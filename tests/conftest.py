@@ -1,0 +1,40 @@
+"""Test configuration: CPU backend with a virtual 8-device mesh.
+
+Mirrors the reference's KaTestrophe trick (oversubscribed single-machine MPI,
+tests/cmake/KaTestrophe.cmake) with the JAX equivalent per SURVEY §4: force 8
+host platform devices so distributed logic is tested on one box.  Must run
+before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override: tests never touch the TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Avoid the axon TPU-tunnel site hook for CPU-only tests: it force-initializes
+# the tunnel backend even under JAX_PLATFORMS=cpu.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    from kaminpar_tpu.utils import reseed
+
+    reseed(42)
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

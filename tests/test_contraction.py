@@ -1,0 +1,78 @@
+"""Contraction kernel tests (reference tier 2: tests/shm cluster contraction
+tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.graph import from_edge_list, generators, validate
+from kaminpar_tpu.ops.contraction import contract_clustering, project_partition
+
+
+def _pad_labels(g, labels):
+    pv = g.padded()
+    idt = pv.row_ptr.dtype
+    return jnp.concatenate(
+        [jnp.asarray(labels, dtype=idt), jnp.full(pv.n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+
+
+def test_contract_path_pairs():
+    g = generators.path_graph(6)  # 0-1-2-3-4-5
+    labels = np.array([0, 0, 2, 2, 4, 4])
+    coarse, coarse_of = contract_clustering(g, _pad_labels(g, labels))
+    validate(coarse)
+    assert coarse.n == 3
+    assert coarse.m == 4  # path of 3 nodes
+    assert coarse.total_node_weight == 6
+    cw = np.asarray(coarse.node_w)
+    assert (cw == 2).all()
+
+
+def test_contract_weights_aggregate():
+    # triangle with two nodes merged -> parallel edges sum
+    g = from_edge_list(3, np.array([[0, 1], [1, 2], [0, 2]]))
+    labels = np.array([0, 0, 2])
+    coarse, _ = contract_clustering(g, _pad_labels(g, labels))
+    validate(coarse)
+    assert coarse.n == 2
+    assert coarse.m == 2
+    # edges (0,2) and (1,2) merge into one coarse edge of weight 2
+    assert np.asarray(coarse.edge_w).max() == 2
+
+
+def test_contract_all_one_cluster():
+    g = generators.complete_graph(5)
+    labels = np.zeros(5, dtype=np.int64)
+    coarse, _ = contract_clustering(g, _pad_labels(g, labels))
+    assert coarse.n == 1
+    assert coarse.m == 0
+    assert coarse.total_node_weight == 5
+
+
+def test_projection_roundtrip():
+    g = generators.grid2d_graph(4, 4)
+    labels = np.asarray(g.col_idx)[np.asarray(g.row_ptr)[:-1]]  # first neighbor
+    labels = np.minimum(labels, np.arange(16))
+    coarse, coarse_of = contract_clustering(g, _pad_labels(g, labels))
+    part_c = np.arange(coarse.n, dtype=np.int32) % 2
+    part_f = np.asarray(project_partition(coarse_of, jnp.asarray(part_c)))
+    assert part_f.shape == (16,)
+    # nodes in the same cluster share the projected block
+    cf = np.asarray(coarse_of)
+    for u in range(16):
+        assert part_f[u] == part_c[cf[u]]
+
+
+def test_contract_preserves_cut_weight():
+    """Total coarse edge weight = fine cut weight between clusters."""
+    g = generators.rmat_graph(8, 6, seed=7)
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 40, g.n)
+    coarse, _ = contract_clustering(g, _pad_labels(g, labels))
+    validate(coarse)
+    u = np.asarray(g.edge_u)
+    v = np.asarray(g.col_idx)
+    w = np.asarray(g.edge_w)
+    inter = labels[u] != labels[v]
+    assert np.asarray(coarse.edge_w).sum() == w[inter].sum()
+    assert coarse.total_node_weight == g.total_node_weight

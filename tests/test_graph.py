@@ -1,0 +1,130 @@
+"""Graph datastructure tests (reference tier 2: tests/shm/ graph tests,
+fixtures from tests/shm/graph_factories.h)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import (
+    CSRGraph,
+    from_edge_list,
+    generators,
+    metrics,
+    rearrange_by_degree_buckets,
+    validate,
+)
+
+
+def test_path_graph():
+    g = generators.path_graph(5)
+    validate(g)
+    assert g.n == 5 and g.m == 8  # 4 undirected edges, stored twice
+    assert g.total_node_weight == 5
+
+
+def test_star_graph():
+    g = generators.star_graph(6)
+    validate(g)
+    assert g.n == 7 and g.m == 12
+    deg = np.asarray(g.degrees())
+    assert deg[0] == 6 and (deg[1:] == 1).all()
+
+
+def test_complete_graph():
+    g = generators.complete_graph(5)
+    validate(g)
+    assert g.m == 5 * 4
+
+
+def test_grid_graph():
+    g = generators.grid2d_graph(3, 4)
+    validate(g)
+    assert g.n == 12
+    assert g.m == 2 * (3 * 3 + 2 * 4)
+
+
+def test_from_edge_list_dedup_and_selfloops():
+    edges = np.array([[0, 1], [0, 1], [1, 2], [2, 2]])
+    g = from_edge_list(3, edges)
+    validate(g)
+    # duplicate (0,1) collapses with summed weight, self-loop dropped
+    assert g.m == 4
+    assert g.total_edge_weight == 6  # (0,1) w=2 both dirs + (1,2) w=1 both dirs
+
+
+def test_weighted_graph():
+    edges = np.array([[0, 1], [1, 2]])
+    g = from_edge_list(3, edges, edge_weights=np.array([5, 7]),
+                       node_weights=np.array([1, 2, 3]))
+    validate(g)
+    assert g.total_node_weight == 6
+    assert g.max_node_weight == 3
+    assert g.total_edge_weight == 24
+
+
+def test_edge_u():
+    g = generators.path_graph(4)
+    u = np.asarray(g.edge_u)
+    col = np.asarray(g.col_idx)
+    row_ptr = np.asarray(g.row_ptr)
+    expect = np.repeat(np.arange(4), np.diff(row_ptr))
+    assert (u == expect).all()
+    assert len(col) == g.m
+
+
+def test_rmat_generator():
+    g = generators.rmat_graph(8, 4, seed=1)
+    validate(g)
+    assert g.n == 256
+    assert g.m > 0
+
+
+def test_rgg2d_generator():
+    g = generators.rgg2d_graph(200, seed=1)
+    validate(g)
+    assert g.n == 200
+
+
+def test_degree_bucket_rearrange():
+    g = generators.star_graph(8)
+    rg, old_to_new = rearrange_by_degree_buckets(g)
+    validate(rg)
+    deg = np.asarray(rg.degrees())
+    assert (np.diff(deg) >= 0).all()  # sorted by bucket
+    # remap: partition of reordered graph maps back
+    assert sorted(old_to_new.tolist()) == list(range(g.n))
+
+
+def test_padded_view():
+    g = generators.path_graph(5)
+    pv = g.padded()
+    assert pv.n == 5 and pv.m == 8
+    assert pv.n_pad > pv.n and pv.m_pad > pv.m
+    assert (pv.n_pad & (pv.n_pad - 1)) == 0  # power of two
+    nw = np.asarray(pv.node_w)
+    assert nw[: pv.n].sum() == 5 and nw[pv.n:].sum() == 0
+    ew = np.asarray(pv.edge_w)
+    assert ew[pv.m:].sum() == 0
+    # pad edges are anchor self-loops
+    col = np.asarray(pv.col_idx)
+    eu = np.asarray(pv.edge_u)
+    assert (col[pv.m:] == pv.anchor).all()
+    assert (eu[pv.m:] == pv.anchor).all()
+
+
+def test_metrics_edge_cut():
+    g = generators.path_graph(4)  # 0-1-2-3
+    part = np.array([0, 0, 1, 1])
+    assert metrics.edge_cut(g, part) == 1
+    part2 = np.array([0, 1, 0, 1])
+    assert metrics.edge_cut(g, part2) == 3
+
+
+def test_metrics_block_weights_imbalance():
+    g = generators.path_graph(4)
+    part = np.array([0, 0, 0, 1])
+    bw = np.asarray(metrics.block_weights(g, part, 2))
+    assert (bw == [3, 1]).all()
+    assert metrics.imbalance(g, part, 2) == pytest.approx(0.5)
+    assert metrics.is_feasible(g, part, 2, [3, 3])
+    assert not metrics.is_feasible(g, part, 2, [2, 2])
+    assert metrics.total_overload(g, part, 2, [2, 2]) == 1

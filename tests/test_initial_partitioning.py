@@ -1,0 +1,85 @@
+"""Initial partitioning tests (reference: initial bipartitioner pool + FM,
+tests exercised through shm endtoend tests; here directly)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.context import InitialPartitioningContext
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.initial.bipartitioner import (
+    _bfs_bipartition,
+    _fm_refine_2way,
+    _ggg_bipartition,
+    _random_bipartition,
+    extract_subgraph,
+    pool_bipartition,
+    recursive_bipartition,
+)
+from kaminpar_tpu.partitioning.kway import graph_to_host
+
+
+@pytest.fixture
+def grid_host():
+    return graph_to_host(generators.grid2d_graph(8, 8))
+
+
+def _balanced_budgets(host, parts=2, eps=0.1):
+    per = int(np.ceil(host.total_node_weight / parts) * (1 + eps)) + 1
+    return np.full(parts, per, dtype=np.int64)
+
+
+@pytest.mark.parametrize("fn", [_bfs_bipartition, _ggg_bipartition, _random_bipartition])
+def test_flat_bipartitioners_feasible(grid_host, rng, fn):
+    mw = _balanced_budgets(grid_host)
+    part = fn(grid_host, mw, rng)
+    assert set(np.unique(part)) <= {0, 1}
+    bw = np.bincount(part, weights=grid_host.node_w, minlength=2)
+    assert bw[0] <= mw[0]
+
+
+def test_fm_improves_cut(grid_host, rng):
+    from kaminpar_tpu.initial.bipartitioner import _cut
+
+    mw = _balanced_budgets(grid_host)
+    part = _random_bipartition(grid_host, mw, rng)
+    before = _cut(grid_host, part)
+    refined = _fm_refine_2way(grid_host, part, mw, rng)
+    after = _cut(grid_host, refined)
+    assert after <= before
+    bw = np.bincount(refined, weights=grid_host.node_w, minlength=2)
+    assert (bw <= mw).all()
+
+
+def test_pool_bipartition_quality(grid_host, rng):
+    from kaminpar_tpu.initial.bipartitioner import _cut
+
+    mw = _balanced_budgets(grid_host)
+    part = pool_bipartition(grid_host, mw, rng, InitialPartitioningContext())
+    # an 8x8 grid has a bisection of width 8; pool+FM should get close
+    assert _cut(grid_host, part) <= 16
+
+
+def test_extract_subgraph(grid_host):
+    part = np.zeros(64, dtype=np.int32)
+    part[32:] = 1
+    sub, nodes = extract_subgraph(grid_host, part, 0)
+    assert sub.n == 32
+    assert (nodes == np.arange(32)).all()
+    # induced 4x8 grid: edges = 2*(3*8 + 4*7) = 104
+    assert len(sub.col_idx) == 104
+
+
+def test_recursive_bipartition_k4(grid_host, rng):
+    mw = _balanced_budgets(grid_host, 4)
+    part = recursive_bipartition(grid_host, 4, mw, rng, InitialPartitioningContext())
+    assert set(np.unique(part)) == {0, 1, 2, 3}
+    bw = np.bincount(part, weights=grid_host.node_w, minlength=4)
+    assert (bw <= mw).all()
+
+
+def test_recursive_bipartition_odd_k(grid_host, rng):
+    mw = np.full(3, 30, dtype=np.int64)
+    part = recursive_bipartition(grid_host, 3, mw, rng, InitialPartitioningContext())
+    assert set(np.unique(part)) == {0, 1, 2}
+    bw = np.bincount(part, weights=grid_host.node_w, minlength=3)
+    assert (bw <= mw).all()

@@ -1,0 +1,114 @@
+"""LP engine kernel tests (reference: the LP engine is exercised through
+lp_clusterer/lp_refiner tests; here we test the jitted rounds directly)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.ops import lp
+from kaminpar_tpu.utils import next_key
+
+
+def _run_rounds(g, max_w_scalar, rounds=5):
+    pv = g.padded()
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate(
+        [jnp.arange(pv.n, dtype=idt), jnp.full(pv.n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+    state = lp.init_state(labels, pv.node_w, pv.n_pad)
+    max_w = jnp.full(pv.n_pad, max_w_scalar, dtype=idt)
+    for _ in range(rounds):
+        state = lp.lp_round(
+            state, next_key(), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+            max_w, num_labels=pv.n_pad,
+        )
+    return pv, state, max_w
+
+
+def test_lp_clusters_respect_weight_limit():
+    g = generators.rmat_graph(9, 8, seed=2)
+    pv, state, max_w = _run_rounds(g, 30)
+    lw = np.asarray(state.label_weights)
+    assert lw.max() <= 30
+    assert lw.sum() == g.total_node_weight
+
+
+def test_lp_merges_connected_nodes():
+    g = generators.complete_graph(8)
+    pv, state, _ = _run_rounds(g, 100)
+    labels = np.asarray(state.labels)[: g.n]
+    # complete graph with no weight limit pressure: everything merges
+    assert len(np.unique(labels)) < 8
+
+
+def test_lp_weight_conservation_on_grid():
+    g = generators.grid2d_graph(8, 8)
+    pv, state, _ = _run_rounds(g, 10)
+    lw = np.asarray(state.label_weights)
+    assert lw.sum() == 64
+    assert lw.max() <= 10
+
+
+def test_isolated_nodes_clustering():
+    # 5 isolated nodes + one edge
+    import numpy as np
+
+    from kaminpar_tpu.graph import from_edge_list
+
+    g = from_edge_list(7, np.array([[5, 6]]))
+    pv = g.padded()
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate(
+        [jnp.arange(pv.n, dtype=idt), jnp.full(pv.n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+    state = lp.init_state(labels, pv.node_w, pv.n_pad)
+    max_w = jnp.full(pv.n_pad, 2, dtype=idt)
+    state = lp.cluster_isolated_nodes(state, pv.row_ptr, pv.node_w, max_w, num_labels=pv.n_pad)
+    labels = np.asarray(state.labels)
+    # isolated nodes 0..4 grouped in pairs of weight <= 2
+    lw = np.asarray(state.label_weights)
+    assert lw.max() <= 2
+    iso_labels = labels[:5]
+    # grouped: fewer clusters than nodes
+    assert len(np.unique(iso_labels)) <= 3
+    # pad nodes untouched (all on anchor)
+    assert (labels[pv.n:] == pv.anchor).all()
+
+
+def test_two_hop_clustering_on_star():
+    # star: leaves can't join the center if its cluster is weight-capped,
+    # but two-hop matches leaves pairwise through their favored cluster
+    g = generators.star_graph(8)
+    pv, state, max_w = _run_rounds(g, 2, rounds=3)
+    state2 = lp.cluster_two_hop_nodes(
+        state, next_key(), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+        max_w, num_labels=pv.n_pad,
+    )
+    lw = np.asarray(state2.label_weights)
+    assert lw.max() <= 2
+    n_clusters_before = len(np.unique(np.asarray(state.labels)[: g.n]))
+    n_clusters_after = len(np.unique(np.asarray(state2.labels)[: g.n]))
+    assert n_clusters_after <= n_clusters_before
+
+
+def test_lp_refinement_mode_small_k():
+    """LP with num_labels=k (block mode) reduces the cut of a bad partition."""
+    from kaminpar_tpu.graph import metrics
+
+    g = generators.grid2d_graph(8, 8)
+    pv = g.padded()
+    rng = np.random.default_rng(3)
+    part = rng.integers(0, 2, g.n).astype(np.int32)
+    init_cut = metrics.edge_cut(g, part)
+    labels = pv.pad_node_array(jnp.asarray(part), 0)
+    state = lp.init_state(labels, pv.node_w, 2)
+    max_w = jnp.full(2, 40, dtype=pv.node_w.dtype)
+    for _ in range(8):
+        state = lp.lp_round(
+            state, next_key(), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+            max_w, num_labels=2,
+        )
+    final_cut = metrics.edge_cut(g, np.asarray(state.labels)[: g.n])
+    assert final_cut < init_cut
+    bw = np.asarray(state.label_weights)
+    assert bw.max() <= 40 and bw.sum() == 64

@@ -1,0 +1,71 @@
+"""Refiner tests: LP refiner, overload balancer, JET (reference tier 2/3)."""
+
+import numpy as np
+
+from kaminpar_tpu.context import BalancerContext, JetContext, LabelPropagationContext
+from kaminpar_tpu.graph import generators, metrics
+from kaminpar_tpu.graph.partitioned import PartitionedGraph
+from kaminpar_tpu.refinement.balancer import OverloadBalancer
+from kaminpar_tpu.refinement.jet import JetRefiner
+from kaminpar_tpu.refinement.lp_refiner import LPRefiner
+
+
+def _grid_pgraph(k=2, noise=0.2, seed=0):
+    g = generators.grid2d_graph(8, 8)
+    rng = np.random.default_rng(seed)
+    # stripes partition + noise
+    part = (np.arange(64) // (64 // k)).clip(0, k - 1).astype(np.int32)
+    flip = rng.random(64) < noise
+    part[flip] = rng.integers(0, k, flip.sum())
+    per = int(np.ceil(64 / k) * 1.1) + 1
+    return PartitionedGraph.create(g, k, part, np.full(k, per, dtype=np.int64))
+
+
+def test_lp_refiner_improves_cut():
+    pg = _grid_pgraph(k=2, noise=0.3)
+    before = pg.edge_cut()
+    refined = LPRefiner(LabelPropagationContext(num_iterations=8)).refine(pg)
+    assert refined.edge_cut() < before
+    assert refined.is_feasible()
+
+
+def test_lp_refiner_keeps_feasibility():
+    pg = _grid_pgraph(k=4, noise=0.2)
+    refined = LPRefiner(LabelPropagationContext()).refine(pg)
+    assert refined.is_feasible()
+
+
+def test_balancer_fixes_overload():
+    g = generators.grid2d_graph(8, 8)
+    part = np.zeros(64, dtype=np.int32)  # everything in block 0: max overload
+    pg = PartitionedGraph.create(g, 4, part, np.full(4, 20, dtype=np.int64))
+    assert not pg.is_feasible()
+    balanced = OverloadBalancer(BalancerContext()).refine(pg)
+    assert balanced.is_feasible()
+
+
+def test_balancer_noop_when_feasible():
+    pg = _grid_pgraph(k=2, noise=0.0)
+    balanced = OverloadBalancer(BalancerContext()).refine(pg)
+    assert np.array_equal(np.asarray(balanced.partition), np.asarray(pg.partition))
+
+
+def test_jet_improves_cut():
+    pg = _grid_pgraph(k=2, noise=0.3, seed=5)
+    before = pg.edge_cut()
+    jet = JetRefiner(JetContext(num_iterations=6), BalancerContext())
+    refined = jet.refine(pg)
+    assert refined.edge_cut() <= before
+    assert refined.is_feasible()
+
+
+def test_jet_on_rmat():
+    g = generators.rmat_graph(8, 8, seed=3)
+    rng = np.random.default_rng(2)
+    part = rng.integers(0, 4, g.n).astype(np.int32)
+    per = int(np.ceil(g.total_node_weight / 4) * 1.1) + 1
+    pg = PartitionedGraph.create(g, 4, part, np.full(4, per, dtype=np.int64))
+    before = pg.edge_cut()
+    refined = JetRefiner(JetContext(num_iterations=8), BalancerContext()).refine(pg)
+    assert refined.edge_cut() < before
+    assert refined.is_feasible()
