@@ -16,9 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Avoid the axon TPU-tunnel site hook for CPU-only tests: it force-initializes
-# the tunnel backend even under JAX_PLATFORMS=cpu.
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+# A site hook may import jax at interpreter startup, in which case jax has
+# already read JAX_PLATFORMS from the ambient env (possibly a TPU tunnel) and
+# the os.environ override above is a no-op.  jax.config.update still works at
+# this point because backends initialize lazily on first use, not on import.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _repo_root not in sys.path:
     sys.path.insert(0, _repo_root)
