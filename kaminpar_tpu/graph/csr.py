@@ -168,6 +168,7 @@ class CSRGraph:
         g._total_node_weight = self._total_node_weight
         g._max_node_weight = self._max_node_weight
         g._total_edge_weight = self._total_edge_weight
+        g._padded = None
         return g
 
     def __repr__(self):
@@ -315,14 +316,12 @@ def permute_nodes(graph: CSRGraph, old_to_new: np.ndarray) -> CSRGraph:
     new_deg = deg[new_to_old]
     new_row_ptr = np.zeros(graph.n + 1, dtype=row_ptr.dtype)
     np.cumsum(new_deg, out=new_row_ptr[1:])
-    new_col = np.empty_like(col)
-    new_ew = np.empty_like(ew)
-    for new_u in range(graph.n):
-        old_u = new_to_old[new_u]
-        s, e = row_ptr[old_u], row_ptr[old_u + 1]
-        ns = new_row_ptr[new_u]
-        seg = old_to_new[col[s:e]]
-        order = np.argsort(seg, kind="stable")
-        new_col[ns : ns + (e - s)] = seg[order]
-        new_ew[ns : ns + (e - s)] = ew[s:e][order]
-    return CSRGraph(new_row_ptr, new_col, nw[new_to_old], new_ew, sorted_by_degree=True)
+    # One vectorized lexsort over (new_u, new_v) rebuilds the adjacency: the
+    # sort groups slots by new source row (matching new_row_ptr, which counts
+    # the same degrees) with neighbor ids ascending within each row.
+    u_new = old_to_new[np.asarray(graph.edge_u)]
+    v_new = old_to_new[col]
+    order = np.lexsort((v_new, u_new))
+    return CSRGraph(
+        new_row_ptr, v_new[order], nw[new_to_old], ew[order], sorted_by_degree=True
+    )

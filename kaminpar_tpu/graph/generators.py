@@ -103,6 +103,10 @@ def rgg2d_graph(n: int, radius: float | None = None, seed: int = 0, **kw) -> CSR
                     continue
                 d = pts_s[me, None, :] - pts_s[None, other, :]
                 close = (d * d).sum(-1) <= r2
+                if dx == 0 and dy == 0:
+                    # Same-cell pairs: keep only i<j, or symmetrization would
+                    # double each pair's weight relative to cross-cell edges.
+                    close = np.triu(close, k=1)
                 ii, jj = np.nonzero(close)
                 out_u.append(order[np.arange(me.start, me.stop)[ii]])
                 out_v.append(order[np.arange(other.start, other.stop)[jj]])

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..graph.csr import CSRGraph
+from .segment import run_ids, run_starts2
 
 
 @jax.jit
@@ -51,10 +52,8 @@ def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     order = jnp.lexsort((kv, ku))
     su, sv = ku[order], kv[order]
     sw = jnp.where(keep[order], edge_w[order], 0)
-    first = jnp.concatenate(
-        [jnp.ones(1, dtype=bool), (su[1:] != su[:-1]) | (sv[1:] != sv[:-1])]
-    )
-    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    first = run_starts2(su, sv)
+    rid = run_ids(first)
     run_w = jax.ops.segment_sum(sw, rid, num_segments=m)
 
     # 4. compact valid runs to the front

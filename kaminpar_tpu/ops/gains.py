@@ -16,6 +16,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .segment import run_ids, run_starts2
+
 
 @partial(jax.jit, static_argnames=("num_labels", "external_only", "respect_caps"))
 def best_moves(
@@ -52,10 +54,8 @@ def best_moves(
     sc = cand[order]
     sw = edge_w[order]
 
-    first = jnp.concatenate(
-        [jnp.ones(1, dtype=bool), (su[1:] != su[:-1]) | (sc[1:] != sc[:-1])]
-    )
-    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    first = run_starts2(su, sc)
+    rid = run_ids(first)
     run_rating = jax.ops.segment_sum(sw, rid, num_segments=m)
     rating = run_rating[rid]
 

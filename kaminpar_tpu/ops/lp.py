@@ -11,11 +11,11 @@ at :817-841).  Design per SURVEY §7 stage 3 / §2.8-2:
   semantic divergence; quality is recovered with random tie-breaking and more
   rounds (and matches the reference's own distributed LP, which is already
   bulk-synchronous per chunk, global_lp_clusterer.cc).
-- Rating accumulation is edge-parallel sort-reduce: sort CSR slots by
-  (source, neighbor-label), reduce runs — no hash maps, static shapes, and
-  high-degree nodes are handled *by construction* (their slots parallelize
-  like everyone else's), subsuming the reference's two-phase machinery
-  (label_propagation.h:571-601,640-815).
+- Rating accumulation is edge-parallel sort-reduce (ops/gains.best_moves):
+  sort CSR slots by (source, neighbor-label), reduce runs — no hash maps,
+  static shapes, and high-degree nodes are handled *by construction* (their
+  slots parallelize like everyone else's), subsuming the reference's
+  two-phase machinery (label_propagation.h:571-601,640-815).
 - The weight-constraint CAS race (load-bearing for balance in the reference)
   becomes a strict capacity auction: movers into each cluster are admitted in
   random priority order while the round-start cluster weight plus the running
@@ -39,6 +39,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .gains import best_moves
+from .segment import run_starts, segment_prefix_sum
+
 
 class LPState(NamedTuple):
     labels: jax.Array  # (n,) current label per node
@@ -51,52 +54,25 @@ def init_state(labels, node_w, num_labels: int) -> LPState:
     return LPState(jnp.asarray(labels), label_weights, jnp.int32(0))
 
 
-def _rate_and_select(key, labels, edge_u, col_idx, edge_w, node_w, label_weights, max_label_weights):
-    """Shared rating + feasibility + random-tie argmax.
+def capacity_auction(key, movers, target, node_w, base_weights, max_weights, num_labels: int):
+    """Admit movers into their target label in random priority order while
+    ``base_weights[target] + running-total <= max_weights[target]`` holds.
 
-    Returns (desired, has_cand): per node, the best-rated feasible target
-    label and whether any candidate existed.  Three segment passes replace the
-    reference's per-thread rating hash maps (rating_map.h):
-    max score → max random tie among maxima → min slot among tie winners.
+    The strict bulk-synchronous stand-in for the reference's CAS loop
+    (label_propagation.h:817-841).  Returns a boolean accept mask.
     """
-    n = labels.shape[0]
-    m = col_idx.shape[0]
-
-    cand = labels[col_idx]
-    order = jnp.lexsort((cand, edge_u))
-    su = edge_u[order]
-    sc = cand[order]
-    sw = edge_w[order]
-
-    first = jnp.concatenate(
-        [jnp.ones(1, dtype=bool), (su[1:] != su[:-1]) | (sc[1:] != sc[:-1])]
-    )
-    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
-    run_rating = jax.ops.segment_sum(sw, rid, num_segments=m)
-    rating = run_rating[rid]
-
-    w_u = node_w[su]
-    is_current = sc == labels[su]
-    fits = label_weights[sc] + w_u <= max_label_weights[sc]
-    feasible = first & (is_current | fits)
-
-    score = jnp.where(feasible, rating, -1)
-    best_score = jax.ops.segment_max(score, su, num_segments=n)
-    eligible = feasible & (rating == best_score[su])
-
-    tie = jax.random.randint(key, (m,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-    tie_masked = jnp.where(eligible, tie, -1)
-    best_tie = jax.ops.segment_max(tie_masked, su, num_segments=n)
-    winner = eligible & (tie_masked == best_tie[su])
-
-    slot = jnp.arange(m, dtype=jnp.int32)
-    slot_masked = jnp.where(winner, slot, m)
-    best_slot = jax.ops.segment_min(slot_masked, su, num_segments=n)
-
-    has_cand = best_score > 0  # edge weights are >= 1, so any candidate rates > 0
-    safe_slot = jnp.clip(best_slot, 0, m - 1)
-    desired = jnp.where(has_cand, sc[safe_slot], labels)
-    return desired, has_cand
+    n = movers.shape[0]
+    prio = jax.random.randint(key, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    tkey = jnp.where(movers, target, num_labels)  # sentinel for non-movers
+    order = jnp.lexsort((prio, tkey))
+    t_s = tkey[order]
+    w_s = jnp.where(movers[order], node_w[order], 0)
+    first = run_starts(t_s)
+    prefix = segment_prefix_sum(w_s, first)
+    t_valid = t_s < num_labels
+    t_idx = jnp.where(t_valid, t_s, 0)
+    ok = t_valid & (base_weights[t_idx] + prefix <= max_weights[t_idx])
+    return jnp.zeros(n, dtype=bool).at[order].set(ok)
 
 
 @partial(jax.jit, static_argnames=("num_labels",))
@@ -117,32 +93,19 @@ def lp_round(
     (label_propagation.h:1682) over all nodes.
     """
     labels, label_weights, _ = state
-    n = labels.shape[0]
     kr, kp = jax.random.split(key)
 
-    desired, _ = _rate_and_select(
-        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights, max_label_weights
+    target, tconn, _, _ = best_moves(
+        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights,
+        max_label_weights, num_labels=num_labels,
+        external_only=False, respect_caps=True,
     )
+    desired = jnp.where(tconn > 0, target, labels)
     moved = desired != labels
 
-    # --- strict capacity auction over round-start weights -----------------
-    prio = jax.random.randint(kp, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-    target = jnp.where(moved, desired, num_labels)  # sentinel for non-movers
-    order2 = jnp.lexsort((prio, target))
-    t_s = target[order2]
-    w_s = jnp.where(moved[order2], node_w[order2], 0)
-    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), t_s[1:] != t_s[:-1]])
-    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
-    cums = jnp.cumsum(w_s)
-    run_base = jax.ops.segment_max(
-        jnp.where(first2, cums - w_s, 0), rid2, num_segments=n
+    accept = capacity_auction(
+        kp, moved, desired, node_w, label_weights, max_label_weights, num_labels
     )
-    prefix = cums - run_base[rid2]
-    t_valid = t_s < num_labels
-    t_idx = jnp.where(t_valid, t_s, 0)
-    ok = t_valid & (label_weights[t_idx] + prefix <= max_label_weights[t_idx])
-    accept = jnp.zeros(n, dtype=bool).at[order2].set(ok)
-
     commit = moved & accept
     new_labels = jnp.where(commit, desired, labels)
     new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
@@ -161,18 +124,22 @@ def cluster_isolated_nodes(
     """Group isolated (degree-0) nodes into max-weight-respecting clusters.
 
     Reference: ``handle_isolated_nodes`` (label_propagation.h:872-917).  The
-    TPU version packs isolated nodes greedily by node order: running weight
-    total // max_weight yields a bucket id, the minimum node id per bucket
-    becomes the representative label.
+    TPU version packs isolated nodes by prefix weight into buckets of width
+    ``cap - w_max + 1`` (w_max = heaviest isolated node): a bucket's total
+    weight is <= width + w_max - 1 = cap even when a node straddles a bucket
+    boundary, so no cluster exceeds the limit.  Slightly more fragmented than
+    the reference's sequential greedy packing, never overweight.
     """
     labels, _, num_moved = state
     n = labels.shape[0]
     deg = row_ptr[1:] - row_ptr[:-1]
     iso = (deg == 0) & (node_w > 0)  # weight-0 degree-0 nodes are shape padding
     w = jnp.where(iso, node_w, 0)
-    cumw = jnp.cumsum(w)
     cap = jnp.maximum(max_label_weights[0], 1)  # scalar limit for clustering
-    bucket = jnp.where(iso, jnp.clip((cumw - w) // cap, 0, n - 1), n)
+    w_max = jnp.max(w)
+    width = jnp.maximum(cap - w_max + 1, 1)
+    start = jnp.cumsum(w) - w
+    bucket = jnp.where(iso, jnp.clip(start // width, 0, n - 1), n)
     bucket = bucket.astype(jnp.int32)
     ids = jnp.arange(n, dtype=labels.dtype)
     rep = jax.ops.segment_min(jnp.where(iso, ids, n), bucket, num_segments=n + 1)
@@ -204,7 +171,6 @@ def cluster_two_hop_nodes(
     """
     labels, label_weights, num_moved = state
     n = labels.shape[0]
-    m = col_idx.shape[0]
     kr, kp = jax.random.split(key)
 
     # Singleton = node alone in its own cluster.
@@ -215,12 +181,13 @@ def cluster_two_hop_nodes(
         cluster_sizes[labels] == 1
     )
 
-    # Favored cluster: plain rating argmax with no weight constraint — reuse
-    # the selector with infinite capacity.
-    inf_cap = jnp.full_like(max_label_weights, jnp.iinfo(jnp.int32).max)
-    favored, has = _rate_and_select(
-        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights, inf_cap
+    # Favored cluster: plain rating argmax with no weight constraint.
+    favored, fconn, _, _ = best_moves(
+        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights,
+        max_label_weights, num_labels=num_labels,
+        external_only=False, respect_caps=False,
     )
+    has = fconn > 0
 
     # Pair up singletons that favor the same cluster: sort by favored id and
     # merge odd positions into the preceding even position's cluster.
@@ -228,7 +195,7 @@ def cluster_two_hop_nodes(
     prio = jax.random.randint(kp, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
     order2 = jnp.lexsort((prio, fkey))
     f_s = fkey[order2]
-    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), f_s[1:] != f_s[:-1]])
+    first2 = run_starts(f_s)
     rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
     starts = jax.ops.segment_max(
         jnp.where(first2, jnp.arange(n, dtype=jnp.int32), 0), rid2, num_segments=n

@@ -25,6 +25,19 @@ def run_starts(sorted_key) -> jax.Array:
     )
 
 
+def run_starts2(sorted_a, sorted_b) -> jax.Array:
+    """run_starts for a composite (a, b) key, lexsorted."""
+    m = sorted_a.shape[0]
+    if m == 0:
+        return jnp.zeros(0, dtype=bool)
+    return jnp.concatenate(
+        [
+            jnp.ones(1, dtype=bool),
+            (sorted_a[1:] != sorted_a[:-1]) | (sorted_b[1:] != sorted_b[:-1]),
+        ]
+    )
+
+
 def run_ids(first_mask) -> jax.Array:
     """Dense run index per slot: [0, #runs)."""
     return jnp.cumsum(first_mask.astype(jnp.int32)) - 1

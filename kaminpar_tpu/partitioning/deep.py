@@ -34,13 +34,19 @@ def extend_partition(
     extract block subgraphs, bipartition each recursively).  Host-side; the
     per-block subgraphs are small relative to the full graph."""
     final_bw = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
-    off_new = split_offsets(len(final_bw), new_k)
-    off = split_offsets(new_k, cur_k)  # block b -> new blocks [off[b], off[b+1])
+    k = len(final_bw)
+    off_new = split_offsets(k, new_k)
+    off_cur = split_offsets(k, cur_k)
+    # Both offset arrays index into *final* blocks; the bisection construction
+    # guarantees off_new refines off_cur, so intermediate block b splits into
+    # the new blocks [lo, hi) whose final ranges tile b's final range.
+    lo_of = np.searchsorted(off_new, off_cur)
+    assert np.array_equal(off_new[lo_of], off_cur), "split refinement violated"
     host = graph_to_host(graph)
     rng = RandomState.numpy_rng()
     out = np.zeros(graph.n, dtype=np.int32)
     for b in range(cur_k):
-        lo, hi = int(off[b]), int(off[b + 1])
+        lo, hi = int(lo_of[b]), int(lo_of[b + 1])
         sub_k = hi - lo
         sub, nodes = extract_subgraph(host, part, b)
         if sub_k <= 1:

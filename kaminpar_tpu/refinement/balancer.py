@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..context import BalancerContext
 from ..graph.partitioned import PartitionedGraph
 from ..ops.gains import best_moves
+from ..ops.segment import run_starts, segment_prefix_sum
 from ..utils import next_key
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
@@ -63,11 +64,8 @@ def _balance_round(key, labels, edge_u, col_idx, edge_w, node_w, max_bw, *, k: i
     order = jnp.lexsort((-rel, src))
     s_s = src[order]
     w_s = jnp.where(eligible[order], node_w[order], 0)
-    first = jnp.concatenate([jnp.ones(1, dtype=bool), s_s[1:] != s_s[:-1]])
-    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
-    cums = jnp.cumsum(w_s)
-    run_base = jax.ops.segment_max(jnp.where(first, cums - w_s, 0), rid, num_segments=n)
-    prefix_excl = cums - run_base[rid] - w_s
+    first = run_starts(s_s)
+    prefix_excl = segment_prefix_sum(w_s, first) - w_s
     s_valid = s_s < k
     s_idx = jnp.where(s_valid, s_s, 0)
     overload = jnp.maximum(block_weights - max_bw, 0)
@@ -80,11 +78,8 @@ def _balance_round(key, labels, edge_u, col_idx, edge_w, node_w, max_bw, *, k: i
     order2 = jnp.lexsort((-rel, tgt))
     t_s = tgt[order2]
     w_t = jnp.where(admitted[order2], node_w[order2], 0)
-    first2 = jnp.concatenate([jnp.ones(1, dtype=bool), t_s[1:] != t_s[:-1]])
-    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
-    cums2 = jnp.cumsum(w_t)
-    run_base2 = jax.ops.segment_max(jnp.where(first2, cums2 - w_t, 0), rid2, num_segments=n)
-    prefix2 = cums2 - run_base2[rid2]
+    first2 = run_starts(t_s)
+    prefix2 = segment_prefix_sum(w_t, first2)
     t_valid = t_s < k
     t_idx = jnp.where(t_valid, t_s, 0)
     keep_tgt = t_valid & (block_weights[t_idx] + prefix2 <= max_bw[t_idx])

@@ -75,11 +75,12 @@ class JetRefiner(Refiner):
         k = p_graph.k
         ctx = self.ctx
         max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
-        temp = (
-            ctx.initial_gain_temp_on_coarse_level
-            if self.coarse_level
-            else ctx.initial_gain_temp_on_fine_level
-        )
+        if self.coarse_level:
+            t0 = ctx.initial_gain_temp_on_coarse_level
+            t1 = ctx.final_gain_temp_on_coarse_level
+        else:
+            t0 = ctx.initial_gain_temp_on_fine_level
+            t1 = ctx.final_gain_temp_on_fine_level
 
         p_graph = self.balancer.refine(p_graph)
         best = p_graph
@@ -89,7 +90,11 @@ class JetRefiner(Refiner):
         fruitless = 0
 
         with scoped_timer("jet_refinement"):
-            for _ in range(ctx.num_iterations):
+            for it in range(ctx.num_iterations):
+                # Linear temperature anneal initial -> final across the
+                # iteration budget (reference: jet_refiner.cc schedules).
+                frac = it / max(ctx.num_iterations - 1, 1)
+                temp = t0 + (t1 - t0) * frac
                 labels, moved = _jet_move_round(
                     next_key(), labels, locked, pv.edge_u, pv.col_idx, pv.edge_w,
                     pv.node_w, max_bw, jnp.float32(temp), k=k,
