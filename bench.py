@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Driver benchmark: LP coarsening throughput (edges/sec) on an RMAT graph.
+
+Mirrors the reference's north-star microbenchmark
+(``apps/benchmarks/shm_label_propagation_benchmark.cc``): build a graph, run
+the LP clustering hot loop, report throughput.  BASELINE config 2 is RMAT
+scale-22 / k=16; the scale is tunable via ``KPTPU_BENCH_SCALE`` so CI boxes
+without a TPU can run a smaller instance.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` divides by a documented estimate of the reference's
+shared-memory LP throughput (~250 M edges/s on a modern multicore; the repo
+publishes no in-tree numbers, BASELINE.json ``published: {}``), so >1.0 means
+faster than the CPU baseline estimate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kaminpar_tpu.coarsening.max_cluster_weights import compute_max_cluster_weight
+from kaminpar_tpu.context import Context
+from kaminpar_tpu.graph.generators import rmat_graph
+from kaminpar_tpu.ops import lp
+from kaminpar_tpu.utils import RandomState, next_key
+
+# Estimated TBB LP throughput of the reference on a modern multicore (no
+# published in-tree number exists; see BASELINE.md).
+CPU_BASELINE_EDGES_PER_SEC = 250e6
+
+
+def main() -> None:
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    default_scale = 22 if on_tpu else 16
+    scale = int(os.environ.get("KPTPU_BENCH_SCALE", default_scale))
+    rounds = int(os.environ.get("KPTPU_BENCH_ROUNDS", 5))
+    k = int(os.environ.get("KPTPU_BENCH_K", 16))
+
+    RandomState.reseed(0)
+    graph = rmat_graph(scale, edge_factor=16, seed=1)
+    pv = graph.padded()
+    n_pad = pv.n_pad
+
+    bv = graph.bucketed()
+    ctx = Context()
+    max_cw = compute_max_cluster_weight(
+        ctx.coarsening, graph.n, graph.total_node_weight, k, 0.03
+    )
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate(
+        [jnp.arange(pv.n, dtype=idt), jnp.full(n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+    state = lp.init_state(labels, pv.node_w, n_pad)
+    max_w = jnp.asarray(max_cw, dtype=idt)
+
+    def one_round(state):
+        return lp.lp_round_bucketed(
+            state, next_key(), bv.buckets, bv.heavy, bv.gather_idx, pv.node_w,
+            max_w, num_labels=n_pad,
+        )
+
+    # Warmup: compile + one real round.  Sync via scalar readback: on the
+    # tunneled TPU backend block_until_ready can return before execution
+    # completes, so a device->host transfer is the only reliable fence.
+    state = one_round(state)
+    int(state.num_moved)
+
+    start = time.perf_counter()
+    for _ in range(rounds):
+        state = one_round(state)
+    int(state.num_moved)
+    elapsed = time.perf_counter() - start
+
+    edges_per_sec = graph.m * rounds / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"lp_clustering_throughput_rmat{scale}",
+                "value": round(edges_per_sec, 1),
+                "unit": "edges/sec",
+                "vs_baseline": round(edges_per_sec / CPU_BASELINE_EDGES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ class LPClustering:
         """Returns padded labels (over graph.padded()); pad nodes carry the
         anchor label."""
         pv = graph.padded()
+        bv = graph.bucketed()
         n_pad = pv.n_pad
         idt = pv.row_ptr.dtype
         labels = jnp.concatenate(
@@ -38,34 +39,35 @@ class LPClustering:
             ]
         )
         state = lp.init_state(labels, pv.node_w, n_pad)
-        max_w = jnp.full(n_pad, int(max_cluster_weight), dtype=idt)
+        # scalar, not a per-cluster table: the clustering weight limit is
+        # uniform and a scalar saves one m-sized gather per round
+        max_w = jnp.asarray(int(max_cluster_weight), dtype=idt)
 
         with scoped_timer("lp_clustering"):
-            for _ in range(self.ctx.num_iterations):
-                state = lp.lp_round(
-                    state,
-                    next_key(),
-                    pv.edge_u,
-                    pv.col_idx,
-                    pv.edge_w,
-                    pv.node_w,
-                    max_w,
-                    num_labels=n_pad,
-                )
-                if int(state.num_moved) <= self.ctx.min_moved_fraction * pv.n:
-                    break
+            state = lp.lp_iterate_bucketed(
+                state,
+                next_key(),
+                bv.buckets,
+                bv.heavy,
+                bv.gather_idx,
+                pv.node_w,
+                max_w,
+                jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+                num_labels=n_pad,
+                max_iterations=self.ctx.num_iterations,
+            )
 
             if self.ctx.cluster_isolated_nodes:
                 state = lp.cluster_isolated_nodes(
                     state, pv.row_ptr, pv.node_w, max_w, num_labels=n_pad
                 )
             if self.ctx.cluster_two_hop_nodes:
-                state = lp.cluster_two_hop_nodes(
+                state = lp.cluster_two_hop_nodes_bucketed(
                     state,
                     next_key(),
-                    pv.edge_u,
-                    pv.col_idx,
-                    pv.edge_w,
+                    bv.buckets,
+                    bv.heavy,
+                    bv.gather_idx,
                     pv.node_w,
                     max_w,
                     num_labels=n_pad,

@@ -104,6 +104,7 @@ class CSRGraph:
         self._max_node_weight: Optional[int] = None
         self._total_edge_weight: Optional[int] = None
         self._padded: Optional[PaddedView] = None
+        self._bucketed = None
 
     def padded(self) -> PaddedView:
         """Shape-bucketed view (cached); see :class:`PaddedView`."""
@@ -130,6 +131,23 @@ class CSRGraph:
                 row_ptr, col_idx, node_w, edge_w, edge_u, self.n, self.m
             )
         return self._padded
+
+    def bucketed(self):
+        """Degree-bucketed adjacency view (cached); see graph/bucketed.py.
+        Indexed against the PaddedView's node space (labels arrays are
+        (n_pad,), pad cols point at the anchor)."""
+        if self._bucketed is None:
+            from .bucketed import build_bucketed_view
+
+            pv = self.padded()
+            self._bucketed = build_bucketed_view(
+                np.asarray(self.row_ptr),
+                np.asarray(self.col_idx),
+                np.asarray(self.edge_w),
+                self.n,
+                pv.anchor,
+            )
+        return self._bucketed
 
     # -- scalar properties (host) -----------------------------------------
 
@@ -169,6 +187,7 @@ class CSRGraph:
         g._max_node_weight = self._max_node_weight
         g._total_edge_weight = self._total_edge_weight
         g._padded = None
+        g._bucketed = None
         return g
 
     def __repr__(self):
@@ -176,28 +195,19 @@ class CSRGraph:
 
 
 def _compute_edge_u(row_ptr, m: int):
-    """edge_u[e] = source node of CSR slot e, via scatter + max-scan.
+    """edge_u[e] = source node of CSR slot e.
 
-    Equivalent to np.repeat(arange(n), degrees) but expressible with static
-    shapes: mark row starts with their node id, then take a running maximum.
-    Rows of length zero contribute no marks and are skipped by the scan.
+    Computed host-side with ``np.repeat`` — graph construction is host
+    orchestration, and a device expression of this (scatter + max-scan) costs
+    a fresh XLA compile per hierarchy-level shape for zero benefit.
     """
+    rp = np.asarray(row_ptr)
+    dtype = rp.dtype
     if m == 0:
-        return jnp.zeros(0, dtype=row_ptr.dtype)
-    n = row_ptr.shape[0] - 1
-    marks = jnp.zeros(m, dtype=row_ptr.dtype)
-    starts = jnp.clip(row_ptr[:-1], 0, m - 1)
-    node_ids = jnp.arange(n, dtype=row_ptr.dtype)
-    # Empty rows share a start slot with the next non-empty row; scatter-max
-    # keeps the largest node id, which is the correct owner of the slot only
-    # if it is non-empty — for empty rows the mark is overwritten by the next
-    # row's mark at the same position... but the largest id wins, which could
-    # be an empty row. Guard: only scatter rows with degree > 0.
-    deg = row_ptr[1:] - row_ptr[:-1]
-    node_ids = jnp.where(deg > 0, node_ids, 0)
-    starts = jnp.where(deg > 0, starts, 0)
-    marks = marks.at[starts].max(node_ids)
-    return jax.lax.associative_scan(jnp.maximum, marks)
+        return jnp.zeros(0, dtype=dtype)
+    n = rp.shape[0] - 1
+    deg = np.diff(rp)
+    return jnp.asarray(np.repeat(np.arange(n, dtype=dtype), deg))
 
 
 def from_numpy_csr(

@@ -1,0 +1,245 @@
+"""Best-move computation over the degree-bucketed layout — the fast path.
+
+Semantics are identical to :func:`kaminpar_tpu.ops.gains.best_moves` (the flat
+sort-reduce reference implementation, kept for cross-checking); the execution
+shape is different: per degree bucket, a batched row-local sort
+(``lax.sort`` along the width axis) + cumulative-sum run reduction replaces
+the global ``m``-element sort.  Heavy rows (degree > MAX_WIDTH) run the flat
+algorithm over just their slots — the TPU rendition of the reference's
+two-phase LP (label_propagation.h:571-601,640-815).
+
+All functions here are meant to be called *inside* an enclosing jit (they
+trace into it); only shapes in the bucketed view determine specialization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.bucketed import Bucket, HeavyPart
+from .segment import run_starts2
+
+_I32MAX = 2**31 - 1
+
+
+def lookup(table_or_scalar, idx):
+    """Index a per-label table, or broadcast a scalar limit (saves a large
+    random gather when the limit is uniform, as in clustering)."""
+    t = jnp.asarray(table_or_scalar)
+    return t if t.ndim == 0 else t[idx]
+
+
+def _bucket_moves(
+    key,
+    labels,
+    bucket: Bucket,
+    node_w,
+    label_weights,
+    max_label_weights,
+    *,
+    external_only: bool,
+    respect_caps: bool,
+):
+    """Per-row best move for one (R, w) bucket.  Returns (target, tconn,
+    own_conn, has_cand), each (R,)."""
+    nodes, cols, wgts = bucket
+    R, w = cols.shape
+    own = labels[nodes]  # (R,)
+    nw = node_w[nodes]  # (R,)
+
+    L = labels[cols]  # (R, w) neighbor labels
+    W = wgts
+    own_conn = jnp.sum(jnp.where(L == own[:, None], W, 0), axis=1)
+
+    Ls, Ws = jax.lax.sort((L, W), dimension=1, num_keys=1)
+    c = jnp.cumsum(Ws, axis=1)
+    change = Ls[:, 1:] != Ls[:, :-1]
+    start = jnp.concatenate([jnp.ones((R, 1), bool), change], axis=1)
+    end = jnp.concatenate([change, jnp.ones((R, 1), bool)], axis=1)
+    # Rating of the run covering each slot, valid at run ends: cumsum minus the
+    # cumsum value just before the run began (propagated by a row cummax, which
+    # is monotone because weights are non-negative).
+    base = jnp.where(start, c - Ws, 0)
+    run_base = jax.lax.cummax(base, axis=1)
+    rating = c - run_base
+
+    is_cur = Ls == own[:, None]
+    # rating > 0 excludes all-pad runs (pad slots have weight 0; real edges
+    # have weight >= 1), matching the flat path where pads don't exist.
+    ok = end & (rating > 0)
+    if external_only:
+        ok = ok & ~is_cur
+    if respect_caps:
+        fits = label_weights[Ls] + nw[:, None] <= lookup(max_label_weights, Ls)
+        ok = ok & fits if external_only else ok & (is_cur | fits)
+
+    score = jnp.where(ok, rating, -1)
+    best = jnp.max(score, axis=1)
+    has = best >= 0
+    eligible = ok & (rating == best[:, None]) & has[:, None]
+    tie = jax.random.randint(key, (R, w), 0, _I32MAX, dtype=jnp.int32)
+    tie_m = jnp.where(eligible, tie, -1)
+    slot = jnp.argmax(tie_m, axis=1)
+    target = jnp.where(has, jnp.take_along_axis(Ls, slot[:, None], axis=1)[:, 0], own)
+    tconn = jnp.where(has, best, 0)
+    return target, tconn, own_conn, has
+
+
+def _heavy_moves(
+    key,
+    labels,
+    heavy: HeavyPart,
+    node_w,
+    label_weights,
+    max_label_weights,
+    *,
+    external_only: bool,
+    respect_caps: bool,
+):
+    """Flat sort-reduce over the heavy rows' slots (mirrors gains.best_moves,
+    with the dense heavy-row index in place of the node id)."""
+    hnodes, hrow, hcols, hw = heavy
+    Hr = hnodes.shape[0]
+    Hs = hcols.shape[0]
+    own = labels[hnodes]  # (Hr,)
+    nw = node_w[hnodes]
+
+    # One variadic sort by (row, label); run ratings via the same cumsum /
+    # cummax trick as the bucket kernel (the global cumsum is monotone, so a
+    # single cummax propagates each run's base) — no m-segment scatters.
+    cand = labels[hcols]
+    sr, sc, sw = jax.lax.sort((hrow, cand, hw), dimension=0, num_keys=2)
+    first = run_starts2(sr, sc)
+    c = jnp.cumsum(sw)
+    base = jnp.where(first, c - sw, 0)
+    run_base = jax.lax.cummax(base)
+    rating = c - run_base  # valid at run *ends*; usable anywhere downstream
+    # mark run ends so per-row maxima only consider complete run totals
+    end = jnp.concatenate([first[1:], jnp.ones(1, dtype=bool)]) if Hs else first
+    rating = jnp.where(end, rating, 0)
+
+    is_cur = sc == own[sr]
+    own_conn = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(end & is_cur, rating, 0), sr, num_segments=Hr,
+            indices_are_sorted=True,
+        ),
+        0,
+    )
+
+    ok = end & (rating > 0)  # excludes all-pad runs, see _bucket_moves
+    if external_only:
+        ok = ok & ~is_cur
+    if respect_caps:
+        fits = label_weights[sc] + nw[sr] <= lookup(max_label_weights, sc)
+        ok = ok & fits if external_only else ok & (is_cur | fits)
+
+    score = jnp.where(ok, rating, -1)
+    best = jax.ops.segment_max(score, sr, num_segments=Hr, indices_are_sorted=True)
+    eligible = ok & (rating == best[sr])
+    tie = jax.random.randint(key, (Hs,), 0, _I32MAX, dtype=jnp.int32)
+    tie_m = jnp.where(eligible, tie, -1)
+    best_tie = jax.ops.segment_max(tie_m, sr, num_segments=Hr, indices_are_sorted=True)
+    winner = eligible & (tie_m == best_tie[sr])
+    slot = jnp.arange(Hs, dtype=jnp.int32)
+    best_slot = jax.ops.segment_min(
+        jnp.where(winner, slot, Hs), sr, num_segments=Hr, indices_are_sorted=True
+    )
+    has = best >= 0
+    safe = jnp.clip(best_slot, 0, max(Hs - 1, 0))
+    target = jnp.where(has, sc[safe], own)
+    tconn = jnp.where(has, best, 0)
+    return target, tconn, own_conn, has
+
+
+def bucketed_best_moves(
+    key,
+    labels,
+    buckets,
+    heavy: HeavyPart,
+    gather_idx,
+    node_w,
+    label_weights,
+    max_label_weights,
+    *,
+    external_only: bool = True,
+    respect_caps: bool = True,
+):
+    """Drop-in equivalent of gains.best_moves over the bucketed layout.
+
+    ``labels``/``node_w`` are (n_pad,) arrays of the graph's PaddedView;
+    returns (target, tconn, own_conn, has_cand) each (n_pad,), with inert
+    defaults (no candidate, no move) on pad nodes.
+    """
+    n = gather_idx.shape[0]
+    n_pad = labels.shape[0]
+    outs = []
+    for i, b in enumerate(buckets):
+        outs.append(
+            _bucket_moves(
+                jax.random.fold_in(key, i),
+                labels,
+                b,
+                node_w,
+                label_weights,
+                max_label_weights,
+                external_only=external_only,
+                respect_caps=respect_caps,
+            )
+        )
+    if heavy.nodes.shape[0] > 0:
+        outs.append(
+            _heavy_moves(
+                jax.random.fold_in(key, len(buckets)),
+                labels,
+                heavy,
+                node_w,
+                label_weights,
+                max_label_weights,
+                external_only=external_only,
+                respect_caps=respect_caps,
+            )
+        )
+
+    target = jnp.concatenate([o[0] for o in outs])[gather_idx]
+    tconn = jnp.concatenate([o[1] for o in outs])[gather_idx]
+    own_conn = jnp.concatenate([o[2] for o in outs])[gather_idx]
+    has = jnp.concatenate([o[3] for o in outs])[gather_idx]
+
+    pad = n_pad - n
+    if pad:
+        target = jnp.concatenate([target, labels[n:]])
+        tconn = jnp.concatenate([tconn, jnp.zeros(pad, dtype=tconn.dtype)])
+        own_conn = jnp.concatenate([own_conn, jnp.zeros(pad, dtype=own_conn.dtype)])
+        has = jnp.concatenate([has, jnp.zeros(pad, dtype=bool)])
+    return target, tconn, own_conn, has
+
+
+def bucketed_neighbor_reduce(fn, buckets, heavy: HeavyPart, gather_idx, n_pad: int):
+    """Generic per-node reduction over neighbors in the bucketed layout.
+
+    ``fn(nodes, cols, wgts) -> (R, w) contributions`` is evaluated per bucket
+    (and per heavy slot with shapes (Hs,)); contributions are summed per row
+    and gathered into an (n_pad,) array (0 on pads).  Used by JET's
+    pessimistic-gain filter, which the reference computes edge-parallel
+    (jet_refiner.cc:135-170).
+    """
+    outs = []
+    for b in buckets:
+        contrib = fn(b.nodes[:, None], b.cols, b.wgts)
+        outs.append(jnp.sum(contrib, axis=1))
+    if heavy.nodes.shape[0] > 0:
+        hnodes, hrow, hcols, hw = heavy
+        contrib = fn(hnodes[hrow], hcols, hw)
+        outs.append(
+            jax.ops.segment_sum(
+                contrib, hrow, num_segments=hnodes.shape[0], indices_are_sorted=True
+            )
+        )
+    n = gather_idx.shape[0]
+    flat = jnp.concatenate(outs)[gather_idx]
+    pad = n_pad - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=flat.dtype)])
+    return flat

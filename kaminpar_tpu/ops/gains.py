@@ -16,6 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .bucketed_gains import lookup
 from .segment import run_ids, run_starts2
 
 
@@ -60,15 +61,20 @@ def best_moves(
     rating = run_rating[rid]
 
     is_current = sc == labels[su]
-    own_conn = jax.ops.segment_max(
-        jnp.where(first & is_current, rating, 0), su, num_segments=n
+    # maximum(..., 0): segment_max of an empty segment (degree-0 node) is
+    # INT32_MIN; its connection to its own block is 0.
+    own_conn = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(first & is_current, rating, 0), su, num_segments=n
+        ),
+        0,
     )
 
     ok = first
     if external_only:
         ok = ok & ~is_current
     if respect_caps:
-        fits = label_weights[sc] + node_w[su] <= max_label_weights[sc]
+        fits = label_weights[sc] + node_w[su] <= lookup(max_label_weights, sc)
         ok = ok & (is_current | fits) if not external_only else ok & fits
 
     score = jnp.where(ok, rating, -1)

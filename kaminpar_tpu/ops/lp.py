@@ -39,6 +39,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .bucketed_gains import bucketed_best_moves, lookup
 from .gains import best_moves
 from .segment import run_starts, segment_prefix_sum
 
@@ -49,6 +50,7 @@ class LPState(NamedTuple):
     num_moved: jax.Array  # () int32 — nodes moved in the last round
 
 
+@partial(jax.jit, static_argnames=("num_labels",))
 def init_state(labels, node_w, num_labels: int) -> LPState:
     label_weights = jax.ops.segment_sum(node_w, labels, num_segments=num_labels)
     return LPState(jnp.asarray(labels), label_weights, jnp.int32(0))
@@ -71,7 +73,7 @@ def capacity_auction(key, movers, target, node_w, base_weights, max_weights, num
     prefix = segment_prefix_sum(w_s, first)
     t_valid = t_s < num_labels
     t_idx = jnp.where(t_valid, t_s, 0)
-    ok = t_valid & (base_weights[t_idx] + prefix <= max_weights[t_idx])
+    ok = t_valid & (base_weights[t_idx] + prefix <= lookup(max_weights, t_idx))
     return jnp.zeros(n, dtype=bool).at[order].set(ok)
 
 
@@ -92,17 +94,19 @@ def lp_round(
     Equivalent work to one ``perform_iteration`` sweep of the reference
     (label_propagation.h:1682) over all nodes.
     """
-    labels, label_weights, _ = state
     kr, kp = jax.random.split(key)
-
     target, tconn, _, _ = best_moves(
-        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights,
+        kr, state.labels, edge_u, col_idx, edge_w, node_w, state.label_weights,
         max_label_weights, num_labels=num_labels,
         external_only=False, respect_caps=True,
     )
+    return _commit_moves(state, kp, target, tconn, node_w, max_label_weights, num_labels)
+
+
+def _commit_moves(state: LPState, kp, target, tconn, node_w, max_label_weights, num_labels: int):
+    labels, label_weights, _ = state
     desired = jnp.where(tconn > 0, target, labels)
     moved = desired != labels
-
     accept = capacity_auction(
         kp, moved, desired, node_w, label_weights, max_label_weights, num_labels
     )
@@ -110,6 +114,64 @@ def lp_round(
     new_labels = jnp.where(commit, desired, labels)
     new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
     return LPState(new_labels, new_weights, jnp.sum(commit).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def lp_round_bucketed(
+    state: LPState,
+    key,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
+    """lp_round over the degree-bucketed layout (the fast path)."""
+    kr, kp = jax.random.split(key)
+    target, tconn, _, _ = bucketed_best_moves(
+        kr, state.labels, buckets, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=True,
+    )
+    return _commit_moves(state, kp, target, tconn, node_w, max_label_weights, num_labels)
+
+
+@partial(jax.jit, static_argnames=("num_labels", "max_iterations"))
+def lp_iterate_bucketed(
+    state: LPState,
+    key,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    min_moved,
+    *,
+    num_labels: int,
+    max_iterations: int,
+) -> LPState:
+    """Up to ``max_iterations`` LP rounds fused into one on-device while loop
+    with the early-exit condition (< min_moved nodes moved) evaluated on
+    device — one dispatch per clustering instead of one per round (the
+    host-loop equivalent of lp_clusterer.cc:94-105)."""
+
+    def cond(carry):
+        i, st = carry
+        return (i < max_iterations) & (st.num_moved > min_moved)
+
+    def body(carry):
+        i, st = carry
+        st = lp_round_bucketed(
+            st, jax.random.fold_in(key, i), buckets, heavy, gather_idx,
+            node_w, max_label_weights, num_labels=num_labels,
+        )
+        return i + 1, st
+
+    state = state._replace(num_moved=jnp.int32(jnp.iinfo(jnp.int32).max))
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
 
 
 @partial(jax.jit, static_argnames=("num_labels",))
@@ -135,7 +197,7 @@ def cluster_isolated_nodes(
     deg = row_ptr[1:] - row_ptr[:-1]
     iso = (deg == 0) & (node_w > 0)  # weight-0 degree-0 nodes are shape padding
     w = jnp.where(iso, node_w, 0)
-    cap = jnp.maximum(max_label_weights[0], 1)  # scalar limit for clustering
+    cap = jnp.maximum(lookup(max_label_weights, 0), 1)  # scalar limit for clustering
     w_max = jnp.max(w)
     width = jnp.maximum(cap - w_max + 1, 1)
     start = jnp.cumsum(w) - w
@@ -169,9 +231,51 @@ def cluster_two_hop_nodes(
     singletons by favored cluster, and merge odd run positions into the
     preceding slot's cluster subject to the weight limit.
     """
+    kr, kp = jax.random.split(key)
+    favored, fconn, _, _ = best_moves(
+        kr, state.labels, edge_u, col_idx, edge_w, node_w, state.label_weights,
+        max_label_weights, num_labels=num_labels,
+        external_only=False, respect_caps=False,
+    )
+    return two_hop_match(state, kp, favored, fconn, node_w, max_label_weights, num_labels=num_labels)
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def cluster_two_hop_nodes_bucketed(
+    state: LPState,
+    key,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
+    """Two-hop clustering with the favored-cluster pass on the bucketed
+    layout."""
+    kr, kp = jax.random.split(key)
+    favored, fconn, _, _ = bucketed_best_moves(
+        kr, state.labels, buckets, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=False,
+    )
+    return two_hop_match(state, kp, favored, fconn, node_w, max_label_weights, num_labels=num_labels)
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def two_hop_match(
+    state: LPState,
+    kp,
+    favored,
+    fconn,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
     labels, label_weights, num_moved = state
     n = labels.shape[0]
-    kr, kp = jax.random.split(key)
 
     # Singleton = node alone in its own cluster.
     cluster_sizes = jax.ops.segment_sum(
@@ -179,13 +283,6 @@ def cluster_two_hop_nodes(
     )
     singleton = (labels == jnp.arange(n, dtype=labels.dtype)) & (
         cluster_sizes[labels] == 1
-    )
-
-    # Favored cluster: plain rating argmax with no weight constraint.
-    favored, fconn, _, _ = best_moves(
-        kr, labels, edge_u, col_idx, edge_w, node_w, label_weights,
-        max_label_weights, num_labels=num_labels,
-        external_only=False, respect_caps=False,
     )
     has = fconn > 0
 
@@ -206,7 +303,7 @@ def cluster_two_hop_nodes(
     valid = (f_s < n) & (pos_in_run % 2 == 1)
     w_s = node_w[order2]
     w_prev = jnp.concatenate([w_s[:1], w_s[:-1]])
-    fits = w_s + w_prev <= max_label_weights[0]
+    fits = w_s + w_prev <= lookup(max_label_weights, 0)
     merge = valid & fits
     new_labels = labels.at[order2].set(
         jnp.where(merge, partner_label, labels[order2])

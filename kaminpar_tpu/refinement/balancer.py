@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..context import BalancerContext
 from ..graph.partitioned import PartitionedGraph
-from ..ops.gains import best_moves
+from ..ops.bucketed_gains import bucketed_best_moves
 from ..ops.segment import run_starts, segment_prefix_sum
 from ..utils import next_key
 from ..utils.timer import scoped_timer
@@ -31,14 +31,14 @@ from .refiner import Refiner
 
 
 @partial(jax.jit, static_argnames=("k",))
-def _balance_round(key, labels, edge_u, col_idx, edge_w, node_w, max_bw, *, k: int):
+def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k: int):
     n = labels.shape[0]
     kb, ks, kt = jax.random.split(key, 3)
     block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
 
-    target, tconn, oconn, has = best_moves(
-        kb, labels, edge_u, col_idx, edge_w, node_w, block_weights, max_bw,
-        num_labels=k, external_only=True, respect_caps=True,
+    target, tconn, oconn, has = bucketed_best_moves(
+        kb, labels, buckets, heavy, gather_idx, node_w, block_weights, max_bw,
+        external_only=True, respect_caps=True,
     )
 
     overloaded = block_weights > max_bw
@@ -98,13 +98,14 @@ class OverloadBalancer(Refiner):
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
         max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
         labels = pv.pad_node_array(p_graph.partition, 0)
         with scoped_timer("overload_balancer"):
             for _ in range(self.ctx.max_num_rounds):
                 labels, num_moved, still = _balance_round(
-                    next_key(), labels, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
-                    max_bw, k=p_graph.k,
+                    next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
+                    pv.node_w, max_bw, k=p_graph.k,
                 )
                 if not bool(still):
                     break

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..context import BalancerContext, JetContext
 from ..graph.partitioned import PartitionedGraph
-from ..ops.gains import best_moves
+from ..ops.bucketed_gains import bucketed_best_moves, bucketed_neighbor_reduce
 from ..utils import next_key
 from ..utils.timer import scoped_timer
 from .balancer import OverloadBalancer
@@ -35,29 +35,30 @@ from .refiner import Refiner
 
 
 @partial(jax.jit, static_argnames=("k",))
-def _jet_move_round(key, labels, locked, edge_u, col_idx, edge_w, node_w, max_bw, temp, *, k: int):
-    n = labels.shape[0]
+def _jet_move_round(key, labels, locked, buckets, heavy, gather_idx, node_w, max_bw, temp, *, k: int):
+    n_pad = labels.shape[0]
     block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
 
     # --- find -------------------------------------------------------------
-    target, tconn, oconn, has = best_moves(
-        key, labels, edge_u, col_idx, edge_w, node_w, block_weights, max_bw,
-        num_labels=k, external_only=True, respect_caps=False,
+    target, tconn, oconn, has = bucketed_best_moves(
+        key, labels, buckets, heavy, gather_idx, node_w, block_weights, max_bw,
+        external_only=True, respect_caps=False,
     )
     gain = tconn - oconn
     threshold = -jnp.floor(temp * oconn.astype(jnp.float32)).astype(gain.dtype)
     cand = has & ~locked & (gain > threshold)
 
-    # --- filter (edge-parallel pessimistic gain) --------------------------
-    gu = gain[edge_u]
-    gv = gain[col_idx]
-    v_cand = cand[col_idx]
-    v_before = v_cand & ((gv > gu) | ((gv == gu) & (col_idx < edge_u)))
-    eff_v = jnp.where(v_before, target[col_idx], labels[col_idx])
-    to_u = target[edge_u]
-    from_u = labels[edge_u]
-    contrib = jnp.where(eff_v == to_u, edge_w, 0) - jnp.where(eff_v == from_u, edge_w, 0)
-    gain2 = jax.ops.segment_sum(jnp.where(cand[edge_u], contrib, 0), edge_u, num_segments=n)
+    # --- filter (pessimistic gain over neighbors) -------------------------
+    def contrib_fn(urow, cols, w):
+        gu = gain[urow]
+        gv = gain[cols]
+        v_before = cand[cols] & ((gv > gu) | ((gv == gu) & (cols < urow)))
+        eff_v = jnp.where(v_before, target[cols], labels[cols])
+        return jnp.where(eff_v == target[urow], w, 0) - jnp.where(
+            eff_v == labels[urow], w, 0
+        )
+
+    gain2 = bucketed_neighbor_reduce(contrib_fn, buckets, heavy, gather_idx, n_pad)
     move = cand & (gain2 > 0)
 
     new_labels = jnp.where(move, target, labels)
@@ -72,6 +73,7 @@ class JetRefiner(Refiner):
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
         k = p_graph.k
         ctx = self.ctx
         max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
@@ -96,7 +98,7 @@ class JetRefiner(Refiner):
                 frac = it / max(ctx.num_iterations - 1, 1)
                 temp = t0 + (t1 - t0) * frac
                 labels, moved = _jet_move_round(
-                    next_key(), labels, locked, pv.edge_u, pv.col_idx, pv.edge_w,
+                    next_key(), labels, locked, bv.buckets, bv.heavy, bv.gather_idx,
                     pv.node_w, max_bw, jnp.float32(temp), k=k,
                 )
                 locked = moved

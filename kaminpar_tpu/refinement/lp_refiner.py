@@ -25,23 +25,23 @@ class LPRefiner(Refiner):
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
         k = p_graph.k
         part = pv.pad_node_array(p_graph.partition, 0)  # pads are inert (w=0)
         state = lp.init_state(part, pv.node_w, k)
         max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
 
         with scoped_timer("lp_refinement"):
-            for _ in range(self.ctx.num_iterations):
-                state = lp.lp_round(
-                    state,
-                    next_key(),
-                    pv.edge_u,
-                    pv.col_idx,
-                    pv.edge_w,
-                    pv.node_w,
-                    max_w,
-                    num_labels=k,
-                )
-                if int(state.num_moved) <= self.ctx.min_moved_fraction * pv.n:
-                    break
+            state = lp.lp_iterate_bucketed(
+                state,
+                next_key(),
+                bv.buckets,
+                bv.heavy,
+                bv.gather_idx,
+                pv.node_w,
+                max_w,
+                jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+                num_labels=k,
+                max_iterations=self.ctx.num_iterations,
+            )
         return p_graph.with_partition(state.labels[: pv.n])

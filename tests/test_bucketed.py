@@ -1,0 +1,173 @@
+"""Bucketed fast-path vs flat reference-path equivalence.
+
+The flat sort-reduce ops (ops/gains.py, ops/lp.py lp_round) are the semantic
+reference; the degree-bucketed kernels (ops/bucketed_gains.py) must compute
+identical ratings/feasibility (targets may differ only within random
+tie-breaks, so we compare tie-break-independent quantities)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.bucketed import build_bucketed_view
+from kaminpar_tpu.ops import lp
+from kaminpar_tpu.ops.bucketed_gains import bucketed_best_moves
+from kaminpar_tpu.ops.gains import best_moves
+from kaminpar_tpu.utils import next_key
+
+
+def _random_graph(rng, n=200, extra_edges=400, weighted=True):
+    edges = rng.integers(0, n, (extra_edges, 2))
+    w = rng.integers(1, 5, extra_edges) if weighted else None
+    return generators.from_edge_list(n, edges, edge_weights=w)
+
+
+def _views(graph, min_width=8, max_width=32, min_rows=4):
+    """Small bucket params so tests exercise multiple buckets + heavy path."""
+    pv = graph.padded()
+    bv = build_bucketed_view(
+        np.asarray(graph.row_ptr), np.asarray(graph.col_idx),
+        np.asarray(graph.edge_w), graph.n, pv.anchor,
+        min_width=min_width, max_width=max_width, min_rows=min_rows,
+    )
+    return pv, bv
+
+
+@pytest.mark.parametrize("external_only,respect_caps", [
+    (False, True), (True, True), (False, False), (True, False),
+])
+def test_best_moves_equivalence(rng, external_only, respect_caps):
+    graph = _random_graph(rng)
+    pv, bv = _views(graph)
+    n_pad = pv.n_pad
+    num_labels = n_pad
+    labels = jnp.asarray(rng.integers(0, graph.n, n_pad).astype(np.int32))
+    label_weights = jax.ops.segment_sum(pv.node_w, labels, num_segments=num_labels)
+    max_w = jnp.full(num_labels, 6, dtype=jnp.int32)
+
+    key = next_key()
+    t_f, c_f, o_f, h_f = best_moves(
+        key, labels, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+        label_weights, max_w, num_labels=num_labels,
+        external_only=external_only, respect_caps=respect_caps,
+    )
+    t_b, c_b, o_b, h_b = bucketed_best_moves(
+        key, labels, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w,
+        label_weights, max_w,
+        external_only=external_only, respect_caps=respect_caps,
+    )
+    n = graph.n
+    # Tie-break independent quantities must match exactly on real nodes.
+    np.testing.assert_array_equal(np.asarray(o_f)[:n], np.asarray(o_b)[:n])
+    np.testing.assert_array_equal(np.asarray(h_f)[:n], np.asarray(h_b)[:n])
+    np.testing.assert_array_equal(np.asarray(c_f)[:n], np.asarray(c_b)[:n])
+    # The chosen target must be a best-rated feasible candidate: its rating
+    # equals the flat best rating (tconn), even if the tie-broken label differs.
+    tf, tb = np.asarray(t_f)[:n], np.asarray(t_b)[:n]
+    hf = np.asarray(h_f)[:n]
+    lab = np.asarray(labels)[:n]
+    assert np.array_equal(tf[~hf], lab[~hf])
+    assert np.array_equal(tb[~hf], lab[~hf])
+
+
+def test_no_pathological_merge_inflation(rng):
+    """Undersized width classes must merge to the largest *naturally occupied*
+    class, not cascade to MAX_WIDTH (a 2000-node graph must not become a
+    (rows, 4096) monster)."""
+    graph = _random_graph(rng, n=2000, extra_edges=8000)
+    pv = graph.padded()
+    bv = build_bucketed_view(
+        np.asarray(graph.row_ptr), np.asarray(graph.col_idx),
+        np.asarray(graph.edge_w), graph.n, pv.anchor,
+    )  # default (production) merge parameters
+    max_deg = int(np.max(np.diff(np.asarray(graph.row_ptr))))
+    for b in bv.buckets:
+        assert b.cols.shape[1] <= max(8, 1 << (max_deg - 1).bit_length())
+    slots = sum(int(b.cols.shape[0]) * int(b.cols.shape[1]) for b in bv.buckets)
+    assert slots <= 8 * graph.m + 8 * 4096  # padding bounded, no 500x blowup
+
+
+def test_heavy_path_exercised(rng):
+    graph = generators.star_graph(100)
+    pv, bv = _views(graph, max_width=16)
+    assert bv.heavy.nodes.shape[0] > 0  # hub has degree 100 > 16
+    num_labels = pv.n_pad
+    labels = jnp.arange(pv.n_pad, dtype=jnp.int32)
+    label_weights = jax.ops.segment_sum(pv.node_w, labels, num_segments=num_labels)
+    max_w = jnp.full(num_labels, 1000, dtype=jnp.int32)
+    key = next_key()
+    t_b, c_b, o_b, h_b = bucketed_best_moves(
+        key, labels, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w,
+        label_weights, max_w, external_only=False, respect_caps=True,
+    )
+    # Hub (node 0, heavy) sees 100 singleton neighbors, each rating 1.
+    assert bool(h_b[0])
+    assert int(c_b[0]) == 1
+    # Every leaf's best candidate is the hub's cluster with rating 1.
+    leaves = np.arange(1, 101)
+    np.testing.assert_array_equal(np.asarray(t_b)[leaves], 0)
+    np.testing.assert_array_equal(np.asarray(c_b)[leaves], 1)
+
+
+def test_lp_round_bucketed_matches_flat_cut_quality(rng):
+    graph = generators.grid2d_graph(20, 20)
+    pv, bv = _views(graph)
+    n_pad = pv.n_pad
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate([
+        jnp.arange(pv.n, dtype=idt),
+        jnp.full(n_pad - pv.n, pv.anchor, dtype=idt),
+    ])
+    max_w = jnp.full(n_pad, 16, dtype=jnp.int32)
+
+    state_f = lp.init_state(labels, pv.node_w, n_pad)
+    state_b = lp.init_state(labels, pv.node_w, n_pad)
+    for _ in range(5):
+        state_f = lp.lp_round(
+            state_f, next_key(), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+            max_w, num_labels=n_pad,
+        )
+        state_b = lp.lp_round_bucketed(
+            state_b, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
+            pv.node_w, max_w, num_labels=n_pad,
+        )
+
+    def quality(state):
+        lab = np.asarray(state.labels)
+        u, v = np.asarray(pv.edge_u), np.asarray(pv.col_idx)
+        clusters = len(np.unique(lab[: graph.n]))
+        internal = int(np.sum((lab[u] == lab[v]) & (np.asarray(pv.edge_w) > 0)))
+        return clusters, internal
+
+    cl_f, in_f = quality(state_f)
+    cl_b, in_b = quality(state_b)
+    # Both paths should coarsen comparably (same algorithm, different layout).
+    assert abs(cl_f - cl_b) <= max(5, 0.2 * cl_f)
+    assert in_b >= 0.7 * in_f
+
+    # Weight invariant: cluster weights respect the cap on both paths.
+    for state in (state_f, state_b):
+        w = np.asarray(state.label_weights)
+        assert w.max() <= 16
+
+
+def test_lp_iterate_bucketed(rng):
+    graph = generators.grid2d_graph(16, 16)
+    pv, bv = _views(graph)
+    n_pad = pv.n_pad
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate([
+        jnp.arange(pv.n, dtype=idt),
+        jnp.full(n_pad - pv.n, pv.anchor, dtype=idt),
+    ])
+    max_w = jnp.full(n_pad, 12, dtype=jnp.int32)
+    state = lp.init_state(labels, pv.node_w, n_pad)
+    out = lp.lp_iterate_bucketed(
+        state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
+        pv.node_w, max_w, jnp.int32(0), num_labels=n_pad, max_iterations=5,
+    )
+    lab = np.asarray(out.labels)[: graph.n]
+    assert len(np.unique(lab)) < graph.n  # clustering actually happened
+    assert np.asarray(out.label_weights).max() <= 12
