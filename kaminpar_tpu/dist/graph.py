@@ -1,0 +1,112 @@
+"""Distributed CSR graph: 1D node-range sharding over a mesh axis.
+
+TPU-native counterpart of ``DistributedCSRGraph``
+(kaminpar-dist/datastructures/distributed_csr_graph.h:39-100): node ranges are
+contiguous per shard (the reference's ``node_distribution[]`` prefix array);
+edges live with the owner of their source endpoint.  Instead of ghost-node
+remapping + growt hash maps, neighbor ids stay *global* and per-round label
+lookups read an all-gathered label table — the dense-exchange trade that fits
+XLA collectives (SURVEY §5 "Distributed communication backend").
+
+Static-shape layout (SURVEY §7 hard part (d)):
+- ``n_loc = next_pow2(ceil((n+1)/P))`` nodes per shard; total padded node
+  space ``N = P * n_loc`` (> n always, so ``N-1`` is a global pad "anchor");
+- ``m_loc = next_pow2(max shard edge count)`` edge slots per shard;
+- all arrays are flat ``(P * per_shard,)`` so ``PartitionSpec('nodes')``
+  splits them into per-shard blocks;
+- pad edge slots: ``u_local = 0``, ``col = N-1`` (anchor), ``w = 0`` (inert:
+  zero-rating runs are never candidates);
+- pad nodes: weight 0, no edges.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from ..graph.csr import CSRGraph
+
+
+def _next_pow2(x: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << (int(max(x, 1)) - 1).bit_length())
+
+
+class DistGraph(NamedTuple):
+    """Host container of the sharded arrays (device placement happens when
+    the arrays enter a pjit/shard_map computation with a 'nodes' spec)."""
+
+    node_w: jax.Array  # (P * n_loc,) node weights, pads 0
+    edge_u: jax.Array  # (P * m_loc,) LOCAL row index of the source
+    col_idx: jax.Array  # (P * m_loc,) GLOBAL neighbor id
+    edge_w: jax.Array  # (P * m_loc,) weights, pads 0
+    n: int  # real node count
+    m: int  # real (directed) edge count
+    n_loc: int
+    m_loc: int
+    num_shards: int
+
+    @property
+    def N(self) -> int:
+        """Padded global node count (= P * n_loc)."""
+        return self.num_shards * self.n_loc
+
+    @property
+    def anchor(self) -> int:
+        return self.N - 1
+
+
+def distribute_graph(graph: CSRGraph, num_shards: int) -> DistGraph:
+    """Split a host CSRGraph into ``num_shards`` contiguous node ranges.
+
+    The reference distributes by node ranges too (dkaminpar.cc ``copy_graph``
+    vtxdist); balanced *edge* distribution would permute by degree first —
+    callers can pre-permute with graph.csr.rearrange_by_degree_buckets.
+    """
+    P = num_shards
+    rp = np.asarray(graph.row_ptr)
+    col = np.asarray(graph.col_idx).astype(np.int32)
+    ew = np.asarray(graph.edge_w).astype(np.int32)
+    nw = np.asarray(graph.node_w).astype(np.int32)
+    n, m = graph.n, graph.m
+
+    n_loc = _next_pow2((n + P) // P)  # ceil((n+1)/P) so N > n (global anchor)
+    N = P * n_loc
+    anchor = N - 1
+
+    counts = [
+        int(rp[min((s + 1) * n_loc, n)] - rp[min(s * n_loc, n)]) for s in range(P)
+    ]
+    m_loc = _next_pow2(max(max(counts), 1))
+
+    node_w = np.zeros(N, dtype=np.int32)
+    node_w[:n] = nw
+    edge_u = np.zeros(P * m_loc, dtype=np.int32)
+    col_idx = np.full(P * m_loc, anchor, dtype=np.int32)
+    edge_w = np.zeros(P * m_loc, dtype=np.int32)
+
+    deg = np.diff(rp)
+    src_global = np.repeat(np.arange(n, dtype=np.int64), deg)
+    for s in range(P):
+        lo_node, hi_node = s * n_loc, min((s + 1) * n_loc, n)
+        if lo_node >= n:
+            continue
+        lo_e, hi_e = int(rp[lo_node]), int(rp[hi_node])
+        cnt = hi_e - lo_e
+        base = s * m_loc
+        edge_u[base : base + cnt] = (src_global[lo_e:hi_e] - lo_node).astype(np.int32)
+        col_idx[base : base + cnt] = col[lo_e:hi_e]
+        edge_w[base : base + cnt] = ew[lo_e:hi_e]
+
+    return DistGraph(
+        node_w=jax.numpy.asarray(node_w),
+        edge_u=jax.numpy.asarray(edge_u),
+        col_idx=jax.numpy.asarray(col_idx),
+        edge_w=jax.numpy.asarray(edge_w),
+        n=n,
+        m=m,
+        n_loc=n_loc,
+        m_loc=m_loc,
+        num_shards=P,
+    )

@@ -1,0 +1,162 @@
+"""Distributed label propagation over a device mesh.
+
+The dKaMinPar global LP clusterer re-designed for SPMD/XLA
+(kaminpar-dist/coarsening/clustering/lp/global_lp_clusterer.cc): clusters may
+span shards; each round is bulk-synchronous —
+
+1. every shard rates its local nodes' candidate clusters from the round-start
+   global label table (one ``all_gather`` over the mesh axis = the ghost-label
+   exchange, replacing ``sparse_alltoall_interface_to_pe``),
+2. global cluster weights are replicated via ``psum`` of shard-local
+   segment sums (replacing the growt global weight map, :437-525),
+3. moves commit **probabilistically** in proportion to the target cluster's
+   remaining capacity (the reference dist LP refiner's PROBABILISTIC
+   execution strategy, dkaminpar.h:116-120), then any cluster that still
+   ended up overweight has this round's in-moves rolled back — the strict
+   bulk-synchronous version of the reference's weight-rollback protocol
+   (global_lp_clusterer.cc:437-525).
+
+Everything here runs *inside* ``shard_map`` over mesh axis ``'nodes'``; the
+host-facing entry points build the shard_map closure for a given mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.bucketed_gains import flat_best_moves, lookup
+
+AXIS = "nodes"
+
+
+def _round_body(key, labels_loc, node_w_loc, edge_u, col_idx, edge_w, max_w,
+                *, num_labels: int, external_only: bool):
+    """One bulk-synchronous LP round; runs per shard inside shard_map."""
+    idx = jax.lax.axis_index(AXIS)
+    kshard = jax.random.fold_in(key, idx)
+    kr, kp = jax.random.split(kshard)
+    n_loc = labels_loc.shape[0]
+
+    # Ghost-label exchange: replicate the round-start label table.
+    labels_glob = jax.lax.all_gather(labels_loc, AXIS, tiled=True)
+
+    def global_weights(lab_loc):
+        return jax.lax.psum(
+            jax.ops.segment_sum(node_w_loc, lab_loc, num_segments=num_labels), AXIS
+        )
+
+    cluster_w = global_weights(labels_loc)
+
+    # Per-shard best moves: the shared flat kernel with candidate labels read
+    # from the gathered global table (ops/bucketed_gains.flat_best_moves).
+    target, tconn, _, _ = flat_best_moves(
+        kr, edge_u, labels_glob[col_idx], edge_w, labels_loc, node_w_loc,
+        cluster_w, max_w, num_rows=n_loc,
+        external_only=external_only, respect_caps=True,
+    )
+    desired = jnp.where(tconn > 0, target, labels_loc)
+    mover = desired != labels_loc
+
+    # Probabilistic commitment: accept ∝ remaining capacity / global demand.
+    demand = jax.lax.psum(
+        jax.ops.segment_sum(
+            jnp.where(mover, node_w_loc, 0), desired, num_segments=num_labels
+        ),
+        AXIS,
+    )
+    remaining = jnp.maximum(lookup(max_w, jnp.arange(num_labels)) - cluster_w, 0)
+    p_accept = jnp.where(demand > 0, remaining / jnp.maximum(demand, 1), 0.0)
+    u = jax.random.uniform(kp, mover.shape)
+    commit = mover & (u < jnp.clip(p_accept[desired], 0.0, 1.0))
+
+    # Rollback to a feasibility fixpoint: reject in-moves of clusters that
+    # ended overweight; a rejected node returns to its source cluster, which
+    # can itself tip overweight, so iterate until no *fixable* (overweight
+    # with kept in-moves) cluster remains.  Pre-existing overload without
+    # in-moves is the balancer's job, not this round's — excluded from the
+    # loop condition so it cannot spin.
+    cap = lookup(max_w, jnp.arange(num_labels))
+
+    def overweight_fixable(kept):
+        w = global_weights(jnp.where(kept, desired, labels_loc))
+        arrivals = jax.lax.psum(
+            jax.ops.segment_sum(
+                kept.astype(jnp.int32), desired, num_segments=num_labels
+            ),
+            AXIS,
+        )
+        return (w > cap) & (arrivals > 0)
+
+    def cond(carry):
+        _, ow_fix = carry
+        return jnp.any(ow_fix)
+
+    def body(carry):
+        kept, ow_fix = carry
+        kept = kept & ~ow_fix[desired]
+        return kept, overweight_fixable(kept)
+
+    kept, _ = jax.lax.while_loop(cond, body, (commit, overweight_fixable(commit)))
+    final_labels = jnp.where(kept, desired, labels_loc)
+    num_moved = jax.lax.psum(jnp.sum(kept).astype(jnp.int32), AXIS)
+    return final_labels, num_moved
+
+
+def make_dist_lp_round(mesh: Mesh, *, num_labels: int, external_only: bool = False):
+    """Build the jitted one-round function for a mesh.
+
+    Takes/returns flat (P*n_loc,)-sharded label arrays; graph arrays are
+    (P*m_loc,)-sharded.  max_w may be a scalar or a (num_labels,) table."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, labels, node_w, edge_u, col_idx, edge_w, max_w):
+        return _round_body(
+            key, labels, node_w, edge_u, col_idx, edge_w, max_w,
+            num_labels=num_labels, external_only=external_only,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_lp_round(mesh, key, labels, graph, max_w, *, num_labels: int,
+                  external_only: bool = False):
+    """Convenience one-round entry (builds + caches nothing; for tests)."""
+    fn = make_dist_lp_round(mesh, num_labels=num_labels, external_only=external_only)
+    return fn(key, labels, graph.node_w, graph.edge_u, graph.col_idx, graph.edge_w, max_w)
+
+
+def dist_lp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
+                    num_rounds: int, external_only: bool = False):
+    """Fixed-round distributed LP loop (host loop; each round one dispatch)."""
+    fn = make_dist_lp_round(mesh, num_labels=num_labels, external_only=external_only)
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        labels, moved = fn(
+            jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
+            graph.col_idx, graph.edge_w, max_w,
+        )
+        total = total + moved
+    return labels, total
+
+
+def shard_arrays(mesh: Mesh, graph, labels):
+    """Place the graph + label arrays with their 1D shardings."""
+    s = NamedSharding(mesh, P(AXIS))
+    return (
+        jax.device_put(labels, s),
+        graph._replace(
+            node_w=jax.device_put(graph.node_w, s),
+            edge_u=jax.device_put(graph.edge_u, s),
+            col_idx=jax.device_put(graph.col_idx, s),
+            edge_w=jax.device_put(graph.edge_w, s),
+        ),
+    )

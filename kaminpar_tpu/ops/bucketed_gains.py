@@ -86,6 +86,75 @@ def _bucket_moves(
     return target, tconn, own_conn, has
 
 
+def flat_best_moves(
+    key,
+    row,
+    cand,
+    w,
+    own,
+    node_w_row,
+    label_weights,
+    max_label_weights,
+    *,
+    num_rows: int,
+    external_only: bool,
+    respect_caps: bool,
+):
+    """Flat run-reduce best-move kernel over (row, candidate-label, weight)
+    slot triples: one variadic sort by (row, label), then run ratings via the
+    cumsum/cummax trick (the global cumsum is monotone, so a single cummax
+    propagates each run's base — no m-segment scatters).
+
+    Shared by the heavy path of the bucketed layout and the per-shard
+    distributed LP kernel (dist/lp.py).  ``own``/``node_w_row`` are
+    (num_rows,); returns per-row (target, tconn, own_conn, has_cand)."""
+    S = cand.shape[0]
+    sr, sc, sw = jax.lax.sort((row, cand, w), dimension=0, num_keys=2)
+    first = run_starts2(sr, sc)
+    c = jnp.cumsum(sw)
+    base = jnp.where(first, c - sw, 0)
+    run_base = jax.lax.cummax(base)
+    rating = c - run_base  # valid at run *ends*
+    # mark run ends so per-row maxima only consider complete run totals
+    end = jnp.concatenate([first[1:], jnp.ones(1, dtype=bool)]) if S else first
+    rating = jnp.where(end, rating, 0)
+
+    is_cur = sc == own[sr]
+    own_conn = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(end & is_cur, rating, 0), sr, num_segments=num_rows,
+            indices_are_sorted=True,
+        ),
+        0,
+    )
+
+    ok = end & (rating > 0)  # excludes all-pad runs, see _bucket_moves
+    if external_only:
+        ok = ok & ~is_cur
+    if respect_caps:
+        fits = label_weights[sc] + node_w_row[sr] <= lookup(max_label_weights, sc)
+        ok = ok & fits if external_only else ok & (is_cur | fits)
+
+    score = jnp.where(ok, rating, -1)
+    best = jax.ops.segment_max(score, sr, num_segments=num_rows, indices_are_sorted=True)
+    eligible = ok & (rating == best[sr])
+    tie = jax.random.randint(key, (S,), 0, _I32MAX, dtype=jnp.int32)
+    tie_m = jnp.where(eligible, tie, -1)
+    best_tie = jax.ops.segment_max(
+        tie_m, sr, num_segments=num_rows, indices_are_sorted=True
+    )
+    winner = eligible & (tie_m == best_tie[sr])
+    slot = jnp.arange(S, dtype=jnp.int32)
+    best_slot = jax.ops.segment_min(
+        jnp.where(winner, slot, S), sr, num_segments=num_rows, indices_are_sorted=True
+    )
+    has = best >= 0
+    safe = jnp.clip(best_slot, 0, max(S - 1, 0))
+    target = jnp.where(has, sc[safe], own)
+    tconn = jnp.where(has, best, 0)
+    return target, tconn, own_conn, has
+
+
 def _heavy_moves(
     key,
     labels,
@@ -97,60 +166,13 @@ def _heavy_moves(
     external_only: bool,
     respect_caps: bool,
 ):
-    """Flat sort-reduce over the heavy rows' slots (mirrors gains.best_moves,
-    with the dense heavy-row index in place of the node id)."""
+    """Heavy rows: the flat kernel with the dense heavy-row index as row key."""
     hnodes, hrow, hcols, hw = heavy
-    Hr = hnodes.shape[0]
-    Hs = hcols.shape[0]
-    own = labels[hnodes]  # (Hr,)
-    nw = node_w[hnodes]
-
-    # One variadic sort by (row, label); run ratings via the same cumsum /
-    # cummax trick as the bucket kernel (the global cumsum is monotone, so a
-    # single cummax propagates each run's base) — no m-segment scatters.
-    cand = labels[hcols]
-    sr, sc, sw = jax.lax.sort((hrow, cand, hw), dimension=0, num_keys=2)
-    first = run_starts2(sr, sc)
-    c = jnp.cumsum(sw)
-    base = jnp.where(first, c - sw, 0)
-    run_base = jax.lax.cummax(base)
-    rating = c - run_base  # valid at run *ends*; usable anywhere downstream
-    # mark run ends so per-row maxima only consider complete run totals
-    end = jnp.concatenate([first[1:], jnp.ones(1, dtype=bool)]) if Hs else first
-    rating = jnp.where(end, rating, 0)
-
-    is_cur = sc == own[sr]
-    own_conn = jnp.maximum(
-        jax.ops.segment_max(
-            jnp.where(end & is_cur, rating, 0), sr, num_segments=Hr,
-            indices_are_sorted=True,
-        ),
-        0,
+    return flat_best_moves(
+        key, hrow, labels[hcols], hw, labels[hnodes], node_w[hnodes],
+        label_weights, max_label_weights, num_rows=hnodes.shape[0],
+        external_only=external_only, respect_caps=respect_caps,
     )
-
-    ok = end & (rating > 0)  # excludes all-pad runs, see _bucket_moves
-    if external_only:
-        ok = ok & ~is_cur
-    if respect_caps:
-        fits = label_weights[sc] + nw[sr] <= lookup(max_label_weights, sc)
-        ok = ok & fits if external_only else ok & (is_cur | fits)
-
-    score = jnp.where(ok, rating, -1)
-    best = jax.ops.segment_max(score, sr, num_segments=Hr, indices_are_sorted=True)
-    eligible = ok & (rating == best[sr])
-    tie = jax.random.randint(key, (Hs,), 0, _I32MAX, dtype=jnp.int32)
-    tie_m = jnp.where(eligible, tie, -1)
-    best_tie = jax.ops.segment_max(tie_m, sr, num_segments=Hr, indices_are_sorted=True)
-    winner = eligible & (tie_m == best_tie[sr])
-    slot = jnp.arange(Hs, dtype=jnp.int32)
-    best_slot = jax.ops.segment_min(
-        jnp.where(winner, slot, Hs), sr, num_segments=Hr, indices_are_sorted=True
-    )
-    has = best >= 0
-    safe = jnp.clip(best_slot, 0, max(Hs - 1, 0))
-    target = jnp.where(has, sc[safe], own)
-    tconn = jnp.where(has, best, 0)
-    return target, tconn, own_conn, has
 
 
 def bucketed_best_moves(
