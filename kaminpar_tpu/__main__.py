@@ -1,0 +1,8 @@
+"""``python -m kaminpar_tpu`` — the KaMinPar binary equivalent."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,12 @@ from typing import NamedTuple
 import jax
 import numpy as np
 
+from functools import partial
+
 from ..graph.csr import CSRGraph
+from ..utils.intmath import next_pow2
 
-
-def _next_pow2(x: int, minimum: int = 8) -> int:
-    return max(minimum, 1 << (int(max(x, 1)) - 1).bit_length())
+_next_pow2 = partial(next_pow2, minimum=8)
 
 
 class DistGraph(NamedTuple):

@@ -61,8 +61,7 @@ class BucketedView(NamedTuple):
         return r + int(self.heavy.nodes.shape[0])
 
 
-def _next_pow2(x: int, minimum: int = 1) -> int:
-    return max(minimum, 1 << (int(max(x, 1)) - 1).bit_length())
+from ..utils.intmath import next_pow2 as _next_pow2
 
 
 def build_bucketed_view(

@@ -25,9 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
+from ..utils.intmath import next_pow2_strict
+
+
 def _next_bucket(x: int, minimum: int = 256) -> int:
-    """Next power-of-2 shape bucket (strictly > x)."""
-    return max(minimum, 1 << int(x).bit_length())
+    """Next power-of-2 shape bucket (strictly > x, reserving pad slots)."""
+    return next_pow2_strict(x, minimum)
 
 
 class PaddedView(NamedTuple):

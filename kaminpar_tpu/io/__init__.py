@@ -1,0 +1,93 @@
+"""Graph + partition IO — public API.
+
+Mirrors ``include/kaminpar-io/kaminpar_io.h:22-54``: ``read_graph(path,
+format)`` with auto-detection, ``write_graph``, and partition read/write
+(one block id per line, the de-facto experiment interface used by the
+reference's refinement benchmark, kaminpar_io.h:46-52).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+
+import numpy as np
+
+from ..graph.csr import CSRGraph
+from .metis import read_metis, write_metis
+from .parhip import read_parhip, write_parhip
+
+
+class GraphFileFormat(enum.Enum):
+    METIS = "metis"
+    PARHIP = "parhip"
+
+
+def _detect(path: str) -> GraphFileFormat:
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".parhip", ".bgf", ".bin"):
+        return GraphFileFormat.PARHIP
+    if ext in (".metis", ".graph"):
+        return GraphFileFormat.METIS
+    # sniff: a ParHIP header's first 8 bytes are a small bitmask (< 64)
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8:
+        v = int(np.frombuffer(head, dtype=np.uint64)[0])
+        if v < 64:
+            return GraphFileFormat.PARHIP
+    return GraphFileFormat.METIS
+
+
+def read_graph(
+    path: str,
+    file_format: GraphFileFormat | str | None = None,
+    *,
+    use_64bit: bool = False,
+) -> CSRGraph:
+    if file_format is None:
+        file_format = _detect(path)
+    elif isinstance(file_format, str):
+        file_format = GraphFileFormat(file_format.lower())
+    if file_format == GraphFileFormat.METIS:
+        return read_metis(path, use_64bit=use_64bit)
+    return read_parhip(path, use_64bit=use_64bit)
+
+
+def write_graph(
+    graph: CSRGraph,
+    path: str,
+    file_format: GraphFileFormat | str | None = None,
+    *,
+    use_64bit: bool = False,
+) -> None:
+    if file_format is None:
+        ext = os.path.splitext(path)[1].lower()
+        file_format = (
+            GraphFileFormat.PARHIP
+            if ext in (".parhip", ".bgf", ".bin")
+            else GraphFileFormat.METIS
+        )
+    elif isinstance(file_format, str):
+        file_format = GraphFileFormat(file_format.lower())
+    if file_format == GraphFileFormat.METIS:
+        write_metis(graph, path)
+    else:
+        write_parhip(graph, path, use_64bit=use_64bit)
+
+
+def write_partition(path: str, partition) -> None:
+    np.savetxt(path, np.asarray(partition, dtype=np.int64), fmt="%d")
+
+
+def read_partition(path: str) -> np.ndarray:
+    return np.loadtxt(path, dtype=np.int64).reshape(-1)
+
+
+def write_block_sizes(path: str, k: int, partition, node_weights=None) -> None:
+    """Per-block total node weight (node count when unweighted).
+    Reference: write_block_sizes (kaminpar_io.h:50)."""
+    part = np.asarray(partition, dtype=np.int64)
+    w = None if node_weights is None else np.asarray(node_weights, dtype=np.int64)
+    sizes = np.bincount(part, weights=w, minlength=k)
+    np.savetxt(path, sizes.astype(np.int64), fmt="%d")

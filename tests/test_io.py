@@ -1,0 +1,90 @@
+"""IO tests: METIS/ParHIP round-trips + compatibility with the reference's
+checked-in sample graphs (read-only; skipped when unavailable)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.csr import validate
+from kaminpar_tpu.io import (
+    GraphFileFormat,
+    read_graph,
+    read_partition,
+    write_graph,
+    write_partition,
+)
+
+REF_MISC = "/root/reference/misc"
+
+
+def _assert_graph_equal(a, b):
+    assert a.n == b.n and a.m == b.m
+    np.testing.assert_array_equal(np.asarray(a.row_ptr), np.asarray(b.row_ptr))
+    np.testing.assert_array_equal(np.asarray(a.col_idx), np.asarray(b.col_idx))
+    np.testing.assert_array_equal(np.asarray(a.node_w), np.asarray(b.node_w))
+    np.testing.assert_array_equal(np.asarray(a.edge_w), np.asarray(b.edge_w))
+
+
+@pytest.mark.parametrize("fmt", ["metis", "parhip"])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_roundtrip(tmp_path, rng, fmt, weighted):
+    edges = rng.integers(0, 50, (120, 2))
+    kw = {}
+    if weighted:
+        kw = dict(
+            edge_weights=rng.integers(1, 9, 120),
+            node_weights=rng.integers(1, 5, 50),
+        )
+    g = generators.from_edge_list(50, edges, **kw)
+    path = str(tmp_path / f"g.{fmt}")
+    write_graph(g, path, fmt)
+    h = read_graph(path, fmt)
+    _assert_graph_equal(g, h)
+
+
+def test_format_autodetect(tmp_path, rng):
+    g = generators.grid2d_graph(5, 5)
+    p_metis = str(tmp_path / "a.graph")
+    p_parhip = str(tmp_path / "a.bin")
+    write_graph(g, p_metis, "metis")
+    write_graph(g, p_parhip, "parhip")
+    _assert_graph_equal(read_graph(p_metis), g)
+    _assert_graph_equal(read_graph(p_parhip), g)
+
+
+def test_metis_degree_zero_and_comments(tmp_path):
+    path = str(tmp_path / "z.metis")
+    with open(path, "w") as f:
+        f.write("% a comment\n3 1\n2\n1\n\n")  # node 3 isolated, blank line
+    g = read_graph(path, "metis")
+    assert g.n == 3 and g.m == 2
+    assert int(np.asarray(g.row_ptr)[-1]) == 2
+    validate(g)
+
+
+def test_partition_roundtrip(tmp_path, rng):
+    part = rng.integers(0, 8, 100)
+    path = str(tmp_path / "p.part")
+    write_partition(path, part)
+    np.testing.assert_array_equal(read_partition(path), part)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REF_MISC}/rgg2d.metis"), reason="reference not mounted"
+)
+def test_reference_rgg2d_metis():
+    g = read_graph(f"{REF_MISC}/rgg2d.metis", "metis")
+    assert g.n == 1024 and g.m == 2 * 4113
+    validate(g)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REF_MISC}/rgg2d-32bit.parhip"), reason="reference not mounted"
+)
+def test_reference_rgg2d_parhip_matches_metis():
+    gm = read_graph(f"{REF_MISC}/rgg2d.metis", "metis")
+    for variant in ("rgg2d-32bit.parhip", "rgg2d-64bit.parhip"):
+        gp = read_graph(f"{REF_MISC}/{variant}", "parhip")
+        _assert_graph_equal(gm, gp)
