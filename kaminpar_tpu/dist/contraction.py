@@ -1,0 +1,205 @@
+"""Distributed cluster contraction — sharded sort-reduce + all-to-all.
+
+Reference: ``kaminpar-dist/coarsening/contraction/global_cluster_contraction.cc``
+(assign coarse ids, migrate coarse edges to their owners via sparse alltoall,
+build the coarse DistributedCSRGraph).  TPU re-design per SURVEY §2.2/§5:
+the sparse MPI alltoall becomes a **dense padded ``jax.lax.all_to_all``** over
+the mesh axis; buffer capacities are measured on device, read back once per
+level (the multilevel loop is host orchestration anyway), and the exchange
+re-runs with static shapes.
+
+Per level:  S1 (jit) relabel-compact + route coarse edges by owner →
+host reads (n_c, send-capacity) → S2 (jit) dense all-to-all + local
+(cu, cv)-aggregate → host reads coarse edge counts → S3 (jit) compact to the
+coarse DistGraph layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.segment import run_starts2
+from ..utils.intmath import next_pow2
+from .graph import DistGraph
+from .lp import AXIS
+
+
+def _next_pow2_dyn(x):
+    """Device-side next power of two with minimum 8 — MUST match the host's
+    ``next_pow2(x, 8)`` exactly (routing in S1 and buffer layout in S2/S3
+    use the two interchangeably).  Integer bit-smear, no float rounding."""
+    x = jnp.maximum(x, 8) - 1
+    for s in (1, 2, 4, 8, 16):
+        x = x | (x >> s)
+    return x + 1
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_shards"))
+def _s1(mesh, labels, node_w, edge_u, col_idx, edge_w, *, num_shards: int):
+    N = labels.shape[0]
+    P_ = num_shards
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def body(labels_loc, node_w_loc, eu, ci, ew):
+        real = node_w_loc > 0
+        # psum of per-shard marks, then clamp: a cluster spanning several
+        # shards is marked by each of them and must still count once.
+        presence = (
+            jax.lax.psum(
+                jnp.zeros(N, jnp.int32).at[jnp.where(real, labels_loc, 0)].max(
+                    jnp.where(real, 1, 0)
+                ),
+                AXIS,
+            )
+            > 0
+        ).astype(jnp.int32)
+        cmap = (jnp.cumsum(presence) - 1).astype(jnp.int32)
+        n_c = jnp.sum(presence)
+        # replicated coarse node weights over the compact id space
+        c_of_loc = jnp.clip(cmap[labels_loc], 0, N - 1)
+        c_node_w = jax.lax.psum(
+            jax.ops.segment_sum(node_w_loc, c_of_loc, num_segments=N), AXIS
+        )
+
+        # coarse endpoints of local edges
+        labels_glob = jax.lax.all_gather(labels_loc, AXIS, tiled=True)
+        cu = jnp.clip(cmap[labels_loc[eu]], 0, N - 1)
+        cv = jnp.clip(cmap[labels_glob[ci]], 0, N - 1)
+        keep = (ew > 0) & (cu != cv)
+
+        # route by owner shard of cu under the coarse layout
+        n_loc_c = _next_pow2_dyn((n_c + P_) // P_)
+        dest = jnp.where(keep, cu // n_loc_c, P_)  # sentinel P_: dropped
+        order = jnp.argsort(dest)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(dest), dest, num_segments=P_ + 1
+        )[:P_]
+        return n_c, c_node_w, c_of_loc, cu[order], cv[order], ew[order] * keep[order], counts
+
+    return body(labels, node_w, edge_u, col_idx, edge_w)
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_shards", "cap", "n_loc_c"))
+def _s2(mesh, s_cu, s_cv, s_w, counts, *, num_shards: int, cap: int, n_loc_c: int):
+    """Dense all-to-all of routed coarse edges + local (cu, cv) aggregation."""
+    P_ = num_shards
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def body(cu, cv, w, cnt):
+        m_loc = cu.shape[0]
+        starts = jnp.concatenate([jnp.zeros(1, cnt.dtype), jnp.cumsum(cnt)[:-1]])
+        dest = jnp.searchsorted(jnp.cumsum(cnt), jnp.arange(m_loc), side="right")
+        pos = jnp.arange(m_loc) - starts[jnp.clip(dest, 0, P_ - 1)]
+        valid = (dest < P_) & (pos < cap) & (w > 0)
+        flat_pos = jnp.where(valid, jnp.clip(dest, 0, P_ - 1) * cap + pos, P_ * cap)
+
+        def scatter(vals, fill):
+            return jnp.full(P_ * cap, fill, vals.dtype).at[flat_pos].set(
+                vals, mode="drop"
+            )
+
+        send_cu = scatter(cu, 0).reshape(P_, cap)
+        send_cv = scatter(cv, 0).reshape(P_, cap)
+        send_w = scatter(w, 0).reshape(P_, cap)
+        r_cu = jax.lax.all_to_all(send_cu, AXIS, 0, 0, tiled=False).reshape(-1)
+        r_cv = jax.lax.all_to_all(send_cv, AXIS, 0, 0, tiled=False).reshape(-1)
+        r_w = jax.lax.all_to_all(send_w, AXIS, 0, 0, tiled=False).reshape(-1)
+
+        # local aggregation by (cu_local, cv)
+        S = r_cu.shape[0]  # P_ * cap
+        cu_l = r_cu - jax.lax.axis_index(AXIS) * n_loc_c
+        key_u = jnp.where(r_w > 0, cu_l, n_loc_c)  # drops sort last
+        su, sv, sw = jax.lax.sort((key_u, r_cv, r_w), dimension=0, num_keys=2)
+        first = run_starts2(su, sv)
+        c = jnp.cumsum(sw)
+        run_base = jax.lax.cummax(jnp.where(first, c - sw, 0))
+        end = jnp.concatenate([first[1:], jnp.ones(1, bool)])
+        run_w = jnp.where(end & (su < n_loc_c), c - run_base, 0)
+        valid_run = end & (su < n_loc_c) & (run_w > 0)
+        m_c_loc = jnp.sum(valid_run)
+        ridx = jnp.cumsum(valid_run) - 1
+        pos2 = jnp.where(valid_run, ridx, S)
+        out_u = jnp.zeros(S, su.dtype).at[pos2].set(su, mode="drop")
+        out_v = jnp.zeros(S, sv.dtype).at[pos2].set(sv, mode="drop")
+        out_w = jnp.zeros(S, sw.dtype).at[pos2].set(run_w, mode="drop")
+        return out_u, out_v, out_w, m_c_loc.astype(jnp.int32).reshape(1)
+
+    return body(s_cu, s_cv, s_w, counts)
+
+
+@partial(jax.jit, static_argnames=("mesh", "m_loc_c", "n_loc_c"))
+def _s3(mesh, agg_u, agg_v, agg_w, c_node_w, *, m_loc_c: int, n_loc_c: int):
+    """Compact per-shard aggregated edges into the coarse DistGraph layout."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def body(u, v, w, cw_full):
+        idx = jax.lax.axis_index(AXIS)
+        eu = u[:m_loc_c]
+        cv = v[:m_loc_c]
+        ew = w[:m_loc_c]
+        nw = jax.lax.dynamic_slice(cw_full, (idx * n_loc_c,), (n_loc_c,))
+        return nw, eu, cv, ew
+
+    return body(agg_u, agg_v, agg_w, c_node_w)
+
+
+def contract_dist_clustering(
+    mesh: Mesh, graph: DistGraph, labels
+) -> Tuple[DistGraph, jax.Array, int]:
+    """Contract a distributed clustering; returns (coarse graph, coarse_of,
+    n_c) where ``coarse_of`` is the (sharded) fine-node → coarse-id map used
+    by uncoarsening projection."""
+    Pn = graph.num_shards
+    n_c, c_node_w, coarse_of, s_cu, s_cv, s_w, counts = _s1(
+        mesh, labels, graph.node_w, graph.edge_u, graph.col_idx, graph.edge_w,
+        num_shards=Pn,
+    )
+    n_c = int(n_c)
+    n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
+    cap = next_pow2(int(np.max(np.asarray(counts))), 8)
+
+    agg_u, agg_v, agg_w, m_c_loc = _s2(
+        mesh, s_cu, s_cv, s_w, counts, num_shards=Pn, cap=cap, n_loc_c=n_loc_c
+    )
+    m_loc_c = next_pow2(int(np.max(np.asarray(m_c_loc))), 8)
+
+    node_w_c, edge_u_c, col_c, edge_w_c = _s3(
+        mesh, agg_u, agg_v, agg_w, c_node_w, m_loc_c=m_loc_c, n_loc_c=n_loc_c
+    )
+    m_total = int(np.sum(np.asarray(m_c_loc)))
+    coarse = DistGraph(
+        node_w=node_w_c, edge_u=edge_u_c, col_idx=col_c, edge_w=edge_w_c,
+        n=n_c, m=m_total, n_loc=n_loc_c, m_loc=m_loc_c, num_shards=Pn,
+    )
+    return coarse, coarse_of, n_c
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def project_partition_up(mesh, coarse_of, coarse_part):
+    """fine_part[u] = coarse_part[coarse_of[u]] across shards (reference:
+    uncoarsening projection, kaminpar-dist deep_multilevel.cc:347)."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS))
+    def body(c_of, c_part):
+        c_glob = jax.lax.all_gather(c_part, AXIS, tiled=True)
+        return c_glob[c_of]
+
+    return body(coarse_of, coarse_part)

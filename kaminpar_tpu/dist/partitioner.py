@@ -1,0 +1,164 @@
+"""dKaMinPar facade: distributed deep multilevel partitioning over a mesh.
+
+Reference: ``kaminpar-dist/dkaminpar.cc:496`` (facade) +
+``kaminpar-dist/partitioning/deep_multilevel.cc`` — coarsen globally until
+the graph is small, **replicate the coarsest graph everywhere and run the
+shared-memory partitioner as initial partitioner**
+(replicate_graph_everywhere → shm KaMinPar, deep_multilevel.cc:132 +
+initial_partitioning/kaminpar_initial_partitioner.cc:63), then uncoarsen with
+distributed refinement.  Here "replicate to shm" = all-gather the coarse
+graph to host (the mesh-wide analog) and run the single-chip pipeline; the
+uncoarsening path projects partitions up across shards and refines with
+distributed LP rounds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..context import Context
+from ..graph.csr import CSRGraph, from_edge_list
+from ..graph import metrics
+from ..utils import RandomState
+from ..utils.logger import Logger, OutputLevel
+from ..utils.timer import scoped_timer
+from .contraction import contract_dist_clustering, project_partition_up
+from .graph import DistGraph, distribute_graph
+from .lp import dist_lp_iterate, shard_arrays
+
+
+@dataclass
+class _Level:
+    graph: DistGraph
+    coarse_of: object  # sharded fine->coarse map
+
+
+@dataclass
+class DKaMinPar:
+    """Distributed facade.  Usage::
+
+        mesh = Mesh(np.array(jax.devices()), ("nodes",))
+        solver = DKaMinPar(mesh, ctx)          # ctx optional (default preset)
+        part = solver.compute_partition(graph, k=16, epsilon=0.03)
+    """
+
+    mesh: Mesh
+    ctx: Optional[Context] = None
+    hierarchy: List[_Level] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.ctx is None:
+            from ..presets import create_context_by_preset_name
+
+            self.ctx = create_context_by_preset_name("default")
+
+    # -- pipeline ----------------------------------------------------------
+
+    def compute_partition(
+        self, graph: CSRGraph, k: int, epsilon: float = 0.03
+    ) -> np.ndarray:
+        P = self.mesh.size
+        ctx = self.ctx
+        RandomState.reseed(ctx.seed)
+        total_w = graph.total_node_weight
+        max_bw_val = int((1.0 + epsilon) * (total_w + k - 1) // k) + graph.max_node_weight
+        C = ctx.coarsening.contraction_limit
+        target_n = max(2 * C, P * C // max(k, 1), 2 * k)
+
+        dg = distribute_graph(graph, P)
+        labels = jnp.arange(dg.N, dtype=jnp.int32)
+        labels, dg = shard_arrays(self.mesh, dg, labels)
+
+        # -- distributed coarsening ---------------------------------------
+        self.hierarchy = []
+        cur = dg
+        with scoped_timer("dist_coarsening"):
+            while cur.n > target_n:
+                max_cw = max(
+                    int(epsilon * total_w / max(min(cur.n // max(C, 1), k), 2)), 1
+                )
+                lab = jnp.arange(cur.N, dtype=jnp.int32)
+                lab, cur = shard_arrays(self.mesh, cur, lab)
+                lab, _ = dist_lp_iterate(
+                    self.mesh, RandomState.next_key(), lab, cur, jnp.int32(max_cw),
+                    num_labels=cur.N,
+                    num_rounds=ctx.coarsening.lp.num_iterations,
+                )
+                coarse, coarse_of, n_c = contract_dist_clustering(self.mesh, cur, lab)
+                shrink = 1.0 - n_c / max(cur.n, 1)
+                Logger.log(
+                    f"  dist coarsening: n={cur.n} -> {n_c} (m={cur.m} -> {coarse.m})",
+                    OutputLevel.DEBUG,
+                )
+                if shrink < ctx.coarsening.convergence_threshold:
+                    break
+                self.hierarchy.append(_Level(cur, coarse_of))
+                cur = coarse
+
+        # -- initial partitioning: replicate coarsest -> shm pipeline ------
+        with scoped_timer("dist_initial_partitioning"):
+            coarse_host = self._replicate_to_host(cur)
+            from ..kaminpar import KaMinPar
+
+            shm = KaMinPar(self.ctx)
+            shm.set_graph(coarse_host)
+            part_host = shm.compute_partition(k=max(min(k, coarse_host.n), 1), epsilon=epsilon)
+            part = np.zeros(cur.N, dtype=np.int32)
+            part[: cur.n] = part_host
+
+        # -- uncoarsening + distributed refinement -------------------------
+        cap = jnp.full(k, max_bw_val, dtype=jnp.int32)
+        with scoped_timer("dist_uncoarsening"):
+            part_dev, cur_shard = shard_arrays(self.mesh, cur, jnp.asarray(part))
+            part_dev = self._refine(part_dev, cur_shard, cap, k)
+            while self.hierarchy:
+                level = self.hierarchy.pop()
+                part_dev = project_partition_up(
+                    self.mesh, level.coarse_of, part_dev
+                )
+                part_dev = self._refine(part_dev, level.graph, cap, k)
+
+        out = np.asarray(part_dev)[: graph.n]
+        cut = metrics.edge_cut(graph, out)
+        Logger.log(
+            f"dist RESULT cut={cut} k={k} n={graph.n} shards={P}",
+            OutputLevel.EXPERIMENT,
+        )
+        return out
+
+    def _refine(self, part, dgraph: DistGraph, cap, k: int):
+        part, dgraph = shard_arrays(self.mesh, dgraph, part)
+        out, _ = dist_lp_iterate(
+            self.mesh, RandomState.next_key(), part, dgraph, cap,
+            num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
+            external_only=False,
+        )
+        return out
+
+    def _replicate_to_host(self, dg: DistGraph) -> CSRGraph:
+        """replicate_graph_everywhere analog: gather the coarse graph off the
+        mesh and rebuild a host CSRGraph (reference: replicator.h:26)."""
+        node_w = np.asarray(dg.node_w)[: dg.n]
+        eu_loc = np.asarray(dg.edge_u).reshape(dg.num_shards, dg.m_loc)
+        cv = np.asarray(dg.col_idx).reshape(dg.num_shards, dg.m_loc)
+        w = np.asarray(dg.edge_w).reshape(dg.num_shards, dg.m_loc)
+        srcs, dsts, ws = [], [], []
+        for s in range(dg.num_shards):
+            real = w[s] > 0
+            srcs.append(eu_loc[s][real] + s * dg.n_loc)
+            dsts.append(cv[s][real])
+            ws.append(w[s][real])
+        src = np.concatenate(srcs)
+        dst = np.concatenate(dsts)
+        ww = np.concatenate(ws)
+        edges = np.stack([src, dst], axis=1)
+        return from_edge_list(
+            dg.n, edges, edge_weights=ww, node_weights=node_w,
+            symmetrize=False, dedup=False,
+        )

@@ -41,17 +41,21 @@ def _tokenize(data: bytes):
     T = token_pos.size
     if T == 0:
         return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
-    line_id = np.cumsum(is_nl) - is_nl  # line index per byte
-    line_of_token = line_id[token_pos]
-
-    # value[t] = sum over its digit chars of digit * 10**(chars to token end)
-    tid = np.cumsum(starts) - 1  # token id per byte (valid at digit bytes)
+    # per-token line id and per-digit token id via searchsorted on positions
+    # (keeps temporaries proportional to token/digit counts, not full-buffer
+    # int64 arrays; the streaming mmap variant is the native-parser's job)
+    nl_pos = np.nonzero(is_nl)[0]
+    line_of_token = np.searchsorted(nl_pos, token_pos)
+    dig_pos = np.nonzero(is_digit)[0]
+    tid_dig = np.searchsorted(token_pos, dig_pos, side="right") - 1
     ws_pos = np.nonzero(is_ws)[0]
     nxt = np.searchsorted(ws_pos, token_pos)
     tok_end = np.where(nxt < ws_pos.size, ws_pos[nxt], buf.size)  # exclusive
-    exp = tok_end[tid] - 1 - np.arange(buf.size)
-    contrib = (buf[is_digit] - ord("0")) * np.power(10.0, exp[is_digit])
-    values = np.bincount(tid[is_digit], weights=contrib, minlength=T)
+
+    # value[t] = sum over its digit chars of digit * 10**(chars to token end)
+    exp = tok_end[tid_dig] - 1 - dig_pos
+    contrib = (buf[dig_pos] - ord("0")) * np.power(10.0, exp)
+    values = np.bincount(tid_dig, weights=contrib, minlength=T)
     if np.any(values >= 2**53):
         raise ValueError("integer token exceeds exact float64 range")
     return values.astype(np.int64), line_of_token

@@ -83,6 +83,20 @@ def write_parhip(graph: CSRGraph, path: str, *, use_64bit: bool = False) -> None
         version |= 4 | 8 | 16 | 32
 
     adj_base = _HDR + (n + 1) * width
+    if not use_64bit:
+        # astype would silently wrap; the reference hard-fails on width
+        # mismatch (ParHIPHeader::validate), so raise rather than corrupt.
+        max_off = adj_base + int(rp[-1]) * width
+        if max_off > 2**32 - 1 or (n and n > 2**32 - 1):
+            raise ValueError("graph too large for 32-bit ParHIP; pass use_64bit=True")
+        for name, arr, lim in (
+            ("node weight", nw, 2**31 - 1),
+            ("edge weight", ew, 2**31 - 1),
+        ):
+            if arr.size and int(arr.max()) > lim:
+                raise ValueError(
+                    f"{name} exceeds 32-bit range; pass use_64bit=True"
+                )
     with open(path, "wb") as f:
         f.write(np.array([version, n, m], dtype=np.uint64).tobytes())
         f.write((adj_base + rp * width).astype(eid_t).tobytes())

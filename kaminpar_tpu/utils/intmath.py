@@ -12,7 +12,3 @@ def next_pow2_strict(x: int, minimum: int = 1) -> int:
     """Smallest power of two strictly > x (used for pad buckets that must
     reserve at least one pad slot, e.g. the anchor node)."""
     return max(minimum, 1 << int(x).bit_length())
-
-
-def ceil_div(a: int, b: int) -> int:
-    return (a + b - 1) // b
