@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -28,6 +30,7 @@ import jax.numpy as jnp
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from kaminpar_tpu.coarsening.max_cluster_weights import compute_max_cluster_weight
+from kaminpar_tpu.utils.platform import force_cpu_devices
 from kaminpar_tpu.context import Context
 from kaminpar_tpu.graph.generators import rmat_graph
 from kaminpar_tpu.ops import lp
@@ -38,8 +41,73 @@ from kaminpar_tpu.utils import RandomState, next_key
 CPU_BASELINE_EDGES_PER_SEC = 250e6
 
 
+def _probe_backend(timeout_s: float) -> tuple[str | None, str | None]:
+    """Probe the ambient JAX backend in a subprocess.
+
+    BENCH_r01 died with an unguarded ``jax.devices()``; worse, the tunneled
+    TPU plugin can *hang* (not fail) during backend init, which no try/except
+    in-process can catch.  A killable subprocess running device enumeration
+    plus a tiny compile is the only reliable test.  The reference's benchmark
+    harness always produces a number (shm_label_propagation_benchmark.cc:29-80);
+    so must we.  Returns (platform_name | None, error | None); any platform
+    name other than "cpu" counts as an accelerator (tunneled plugins may
+    register under a non-"tpu" name).
+    """
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "plats = sorted({d.platform for d in jax.devices()})\n"
+        "jnp.zeros(8).sum().block_until_ready()\n"
+        "print('PROBE_OK', ','.join(plats))\n"
+    )
+    try:
+        # Own process group so a timeout kill reaches any helper the plugin
+        # forked (ssh/grpc proxies inherit the pipes; killing only the direct
+        # child would leave communicate() blocked on pipe EOF forever).
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+        )
+        try:
+            out, errout = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.communicate()
+            return None, f"backend init timed out after {timeout_s:.0f}s"
+    except Exception as exc:  # noqa: BLE001
+        return None, f"{type(exc).__name__}: {exc}"[:500]
+    if proc.returncode == 0:
+        for line in out.splitlines():
+            if line.startswith("PROBE_OK"):
+                plats = line.split(None, 1)[1].split(",") if " " in line else []
+                accel = [p for p in plats if p != "cpu"]
+                return (accel[0] if accel else "cpu"), None
+    return None, (errout.strip().splitlines() or ["probe failed"])[-1][:500]
+
+
+def _init_backend() -> tuple[str, str | None]:
+    """Pick a backend that is guaranteed to work: the ambient accelerator if
+    the probe passes, else CPU with the probe's error recorded.  Returns
+    (name, error|None); name "cpu" = no accelerator configured (clean),
+    "cpu-fallback" = accelerator configured but broken."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return "cpu", None
+    timeout_s = float(os.environ.get("KPTPU_TPU_PROBE_TIMEOUT", 90))
+    platform, err = _probe_backend(timeout_s)
+    if platform is not None:
+        # Residual risk: the parent re-initializes the backend after the
+        # probe, so a tunnel that wedges *between* probe and init still
+        # hangs; the driver's outer timeout is the backstop for that.
+        return platform, None
+    force_cpu_devices(1)
+    return "cpu-fallback", err
+
+
 def main() -> None:
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    backend, backend_err = _init_backend()
+    on_tpu = backend not in ("cpu", "cpu-fallback")
     default_scale = 22 if on_tpu else 16
     scale = int(os.environ.get("KPTPU_BENCH_SCALE", default_scale))
     rounds = int(os.environ.get("KPTPU_BENCH_ROUNDS", 5))
@@ -81,16 +149,16 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     edges_per_sec = graph.m * rounds / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": f"lp_clustering_throughput_rmat{scale}",
-                "value": round(edges_per_sec, 1),
-                "unit": "edges/sec",
-                "vs_baseline": round(edges_per_sec / CPU_BASELINE_EDGES_PER_SEC, 4),
-            }
-        )
-    )
+    record = {
+        "metric": f"lp_clustering_throughput_rmat{scale}",
+        "value": round(edges_per_sec, 1),
+        "unit": "edges/sec",
+        "vs_baseline": round(edges_per_sec / CPU_BASELINE_EDGES_PER_SEC, 4),
+        "backend": backend,
+    }
+    if backend_err:
+        record["error"] = backend_err
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,8 +1,10 @@
 from .logger import Logger, OutputLevel, log_result_line
+from .platform import force_cpu_devices
 from .rng import RandomState, next_key, reseed
 from .timer import Timer, scoped_timer
 
 __all__ = [
+    "force_cpu_devices",
     "Logger",
     "OutputLevel",
     "log_result_line",

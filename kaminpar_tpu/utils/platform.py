@@ -1,0 +1,44 @@
+"""Backend/platform forcing helpers.
+
+The multi-device distributed tier is validated on virtual CPU devices — the
+JAX analog of the reference's oversubscribed single-machine MPI testing
+(tests/cmake/KaTestrophe.cmake, SURVEY §4).  Forcing must happen in-process
+because the ambient environment may point JAX at a TPU tunnel whose backend
+hangs during init: env mutation alone is not enough when a site hook has
+already imported jax, but ``jax.config.update`` still works at that point
+since backends initialize lazily on first use, not on import.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int) -> list:
+    """Force the CPU platform with at least ``n_devices`` virtual devices.
+
+    Must be called before the CPU backend is first used.  Any pre-existing
+    ``xla_force_host_platform_device_count`` flag is replaced (a smaller
+    inherited count would otherwise win and starve the mesh).  Returns the
+    first ``n_devices`` CPU devices.
+    """
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    existing = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    count = max(n_devices, int(existing.group(1)) if existing else 0)
+    flags = re.sub(rf"{_COUNT_FLAG}=\d+", "", flags).strip()
+    os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={count}".strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices("cpu")
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"requested {n_devices} virtual CPU devices but the backend "
+            f"initialized with {len(devs)}; the CPU backend was already "
+            "live before force_cpu_devices was called"
+        )
+    return devs[:n_devices]

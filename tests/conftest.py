@@ -2,30 +2,22 @@
 
 Mirrors the reference's KaTestrophe trick (oversubscribed single-machine MPI,
 tests/cmake/KaTestrophe.cmake) with the JAX equivalent per SURVEY §4: force 8
-host platform devices so distributed logic is tested on one box.  Must run
-before jax initializes, hence the env mutation at import time.
+host platform devices so distributed logic is tested on one box.  The forcing
+recipe lives in ``kaminpar_tpu.utils.platform.force_cpu_devices`` (shared with
+``__graft_entry__``); it works even when a site hook pre-imported jax because
+backends initialize lazily.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # override: tests never touch the TPU
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# A site hook may import jax at interpreter startup, in which case jax has
-# already read JAX_PLATFORMS from the ambient env (possibly a TPU tunnel) and
-# the os.environ override above is a no-op.  jax.config.update still works at
-# this point because backends initialize lazily on first use, not on import.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _repo_root not in sys.path:
     sys.path.insert(0, _repo_root)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
