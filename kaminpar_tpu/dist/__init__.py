@@ -8,4 +8,8 @@ exchange rides XLA collectives over ICI instead of sparse MPI alltoalls
 """
 
 from .graph import DistGraph, distribute_graph  # noqa: F401
-from .lp import dist_lp_round, dist_lp_iterate  # noqa: F401
+from .lp import (  # noqa: F401
+    dist_cluster_iterate,
+    dist_lp_iterate,
+    dist_lp_round,
+)

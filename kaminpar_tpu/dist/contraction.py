@@ -3,15 +3,29 @@
 Reference: ``kaminpar-dist/coarsening/contraction/global_cluster_contraction.cc``
 (assign coarse ids, migrate coarse edges to their owners via sparse alltoall,
 build the coarse DistributedCSRGraph).  TPU re-design per SURVEY §2.2/§5:
-the sparse MPI alltoall becomes a **dense padded ``jax.lax.all_to_all``** over
-the mesh axis; buffer capacities are measured on device, read back once per
-level (the multilevel loop is host orchestration anyway), and the exchange
-re-runs with static shapes.
+the sparse MPI alltoall becomes a **dense padded ``jax.lax.all_to_all``**
+over the mesh axis, with buffer capacities measured on device and read back
+once per level (the multilevel loop is host orchestration anyway).
 
-Per level:  S1 (jit) relabel-compact + route coarse edges by owner →
-host reads (n_c, send-capacity) → S2 (jit) dense all-to-all + local
-(cu, cv)-aggregate → host reads coarse edge counts → S3 (jit) compact to the
-coarse DistGraph layout.
+No per-shard array is O(N): cluster-id compaction is *owner-computed* —
+the owner shard of each cluster id (owner = id // n_loc) marks used ids in
+its own (n_loc,) range, shards exchange only the P used-counts for the
+exclusive scan, and fine shards fetch compact ids via owner-routed queries
+(``exchange.owner_query``).  This replaces the previous design's
+psum-of-(N,)-presence arrays, which made per-device memory O(N).
+
+Per level:
+  S1 (jit)  owner-aggregate cluster weights → used marks → exscan compact
+            ids; read back n_c + overflow.
+  S2 (jit)  ghost-exchange labels, owner-query compact ids for every
+            neighbor slot, route coarse edges + coarse node weights to
+            their coarse-layout owners (sort by destination); read back
+            send counts.
+  S3 (jit)  dense all-to-all + local (cu, cv) sort-reduce aggregation +
+            node-weight aggregation; read back coarse edge counts.
+  S4 (jit)  compact to the coarse layout; host builds the coarse ghost
+            routing from the aggregated global ids (O(m_c) host work on a
+            geometrically shrinking series).
 """
 
 from __future__ import annotations
@@ -26,13 +40,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.segment import run_starts2
 from ..utils.intmath import next_pow2
+from .exchange import (
+    AXIS,
+    build_ghost_exchange,
+    ghost_exchange,
+    localize_columns,
+    owner_aggregate,
+    owner_query,
+)
 from .graph import DistGraph
-from .lp import AXIS
 
 
 def _next_pow2_dyn(x):
     """Device-side next power of two with minimum 8 — MUST match the host's
-    ``next_pow2(x, 8)`` exactly (routing in S1 and buffer layout in S2/S3
+    ``next_pow2(x, 8)`` exactly (routing in S2 and buffer layout in S3/S4
     use the two interchangeably).  Integer bit-smear, no float rounding."""
     x = jnp.maximum(x, 8) - 1
     for s in (1, 2, 4, 8, 16):
@@ -40,88 +61,130 @@ def _next_pow2_dyn(x):
     return x + 1
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_shards"))
-def _s1(mesh, labels, node_w, edge_u, col_idx, edge_w, *, num_shards: int):
-    N = labels.shape[0]
-    P_ = num_shards
+@partial(jax.jit, static_argnames=("mesh", "n_loc", "cap_q"))
+def _s1(mesh, labels, node_w, *, n_loc: int, cap_q: int):
+    """Owner-computed compaction: cluster weights + used marks + compact ids."""
 
     @partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(), P(AXIS), P(AXIS), P()),
     )
-    def body(labels_loc, node_w_loc, eu, ci, ew):
+    def body(labels_loc, node_w_loc):
         real = node_w_loc > 0
-        # psum of per-shard marks, then clamp: a cluster spanning several
-        # shards is marked by each of them and must still count once.
-        presence = (
-            jax.lax.psum(
-                jnp.zeros(N, jnp.int32).at[jnp.where(real, labels_loc, 0)].max(
-                    jnp.where(real, 1, 0)
-                ),
-                AXIS,
-            )
-            > 0
-        ).astype(jnp.int32)
-        cmap = (jnp.cumsum(presence) - 1).astype(jnp.int32)
-        n_c = jnp.sum(presence)
-        # replicated coarse node weights over the compact id space
-        c_of_loc = jnp.clip(cmap[labels_loc], 0, N - 1)
-        c_node_w = jax.lax.psum(
-            jax.ops.segment_sum(node_w_loc, c_of_loc, num_segments=N), AXIS
+        cw_own, ovf = owner_aggregate(labels_loc, node_w_loc, ~real, n_loc, cap_q)
+        used = cw_own > 0
+        cnt = jnp.sum(used).astype(jnp.int32)
+        cnts = jax.lax.all_gather(cnt, AXIS)  # (P,) — O(P), not O(N)
+        idx = jax.lax.axis_index(AXIS)
+        base = (jnp.cumsum(cnts) - cnts)[idx].astype(labels_loc.dtype)
+        cmap_own = jnp.where(
+            used, base + jnp.cumsum(used.astype(labels_loc.dtype)) - 1, -1
+        )
+        n_c = jax.lax.psum(cnt, AXIS)  # psum → statically replicated
+        return n_c, cw_own, cmap_own, jax.lax.psum(ovf, AXIS)
+
+    return body(labels, node_w)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "n_loc", "n_loc_c", "cap_q"),
+)
+def _s2(mesh, labels, cmap_own, cw_own, edge_u, col_loc, edge_w, send_idx,
+        recv_map, *, n_loc: int, n_loc_c: int, cap_q: int):
+    """Coarse endpoints via owner queries; route edges + weights by coarse owner."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS),) * 8,
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                   P(AXIS), P(AXIS), P(AXIS), P()),
+    )
+    def body(labels_loc, cmap_own_loc, cw_own_loc, eu, cl, ew, sidx, rmap):
+        nshards = jax.lax.axis_size(AXIS)
+        ghost_labels = ghost_exchange(
+            labels_loc, sidx, rmap, fill=jnp.asarray(-1, labels_loc.dtype)
+        )
+        qkeys = jnp.concatenate([labels_loc, ghost_labels])
+        qdrop = qkeys < 0
+        cvals, ovf = owner_query(
+            qkeys, qdrop, cmap_own_loc, n_loc, cap_q,
+            fill=jnp.asarray(-1, labels_loc.dtype),
+        )
+        g_loc = ghost_labels.shape[0]
+        cmap_slot = jnp.concatenate(
+            [cvals, jnp.full((1,), -1, cvals.dtype)]
+        )  # (n_loc + g_loc + 1,)
+        cu_node = cvals[:n_loc]  # coarse id of each local node (= coarse_of)
+        cu = cu_node[eu]
+        cv = cmap_slot[jnp.clip(cl, 0, n_loc + g_loc)]
+        keep = (ew > 0) & (cu != cv) & (cu >= 0) & (cv >= 0)
+
+        # route edges by owner shard of cu under the coarse layout
+        dest = jnp.where(keep, cu // n_loc_c, nshards).astype(jnp.int32)
+        order = jnp.argsort(dest, stable=True)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(dest), dest, num_segments=nshards + 1
+        )[:nshards]
+
+        # route coarse node weights by owner of the compact id
+        used = cmap_own_loc >= 0
+        wdest = jnp.where(used, cmap_own_loc // n_loc_c, nshards).astype(jnp.int32)
+        worder = jnp.argsort(wdest, stable=True)
+        wcounts = jax.ops.segment_sum(
+            jnp.ones_like(wdest), wdest, num_segments=nshards + 1
+        )[:nshards]
+
+        return (
+            cu_node,
+            cu[order], cv[order], jnp.where(keep, ew, 0)[order], counts,
+            cmap_own_loc[worder], cw_own_loc[worder], wcounts,
+            jax.lax.psum(ovf, AXIS),
         )
 
-        # coarse endpoints of local edges
-        labels_glob = jax.lax.all_gather(labels_loc, AXIS, tiled=True)
-        cu = jnp.clip(cmap[labels_loc[eu]], 0, N - 1)
-        cv = jnp.clip(cmap[labels_glob[ci]], 0, N - 1)
-        keep = (ew > 0) & (cu != cv)
-
-        # route by owner shard of cu under the coarse layout
-        n_loc_c = _next_pow2_dyn((n_c + P_) // P_)
-        dest = jnp.where(keep, cu // n_loc_c, P_)  # sentinel P_: dropped
-        order = jnp.argsort(dest)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(dest), dest, num_segments=P_ + 1
-        )[:P_]
-        return n_c, c_node_w, c_of_loc, cu[order], cv[order], ew[order] * keep[order], counts
-
-    return body(labels, node_w, edge_u, col_idx, edge_w)
+    return body(labels, cmap_own, cw_own, edge_u, col_loc, edge_w,
+                send_idx, recv_map)
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_shards", "cap", "n_loc_c"))
-def _s2(mesh, s_cu, s_cv, s_w, counts, *, num_shards: int, cap: int, n_loc_c: int):
-    """Dense all-to-all of routed coarse edges + local (cu, cv) aggregation."""
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "num_shards", "cap", "cap_w", "n_loc_c"),
+)
+def _s3(mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts, *,
+        num_shards: int, cap: int, cap_w: int, n_loc_c: int):
+    """Dense all-to-all of routed edges/weights + local aggregation."""
     P_ = num_shards
+
+    def _pack(dest_sorted_vals, cnt, cap_, fill):
+        m = dest_sorted_vals.shape[0]
+        starts = jnp.concatenate([jnp.zeros(1, cnt.dtype), jnp.cumsum(cnt)[:-1]])
+        dest = jnp.searchsorted(jnp.cumsum(cnt), jnp.arange(m), side="right")
+        pos = jnp.arange(m) - starts[jnp.clip(dest, 0, P_ - 1)]
+        valid = (dest < P_) & (pos < cap_)
+        flat_pos = jnp.where(valid, jnp.clip(dest, 0, P_ - 1) * cap_ + pos, P_ * cap_)
+        buf = jnp.full(P_ * cap_ + 1, fill, dest_sorted_vals.dtype)
+        return buf.at[flat_pos].set(
+            jnp.where(valid, dest_sorted_vals, fill), mode="drop"
+        )[: P_ * cap_].reshape(P_, cap_)
 
     @partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        in_specs=(P(AXIS),) * 7,
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
     )
-    def body(cu, cv, w, cnt):
-        m_loc = cu.shape[0]
-        starts = jnp.concatenate([jnp.zeros(1, cnt.dtype), jnp.cumsum(cnt)[:-1]])
-        dest = jnp.searchsorted(jnp.cumsum(cnt), jnp.arange(m_loc), side="right")
-        pos = jnp.arange(m_loc) - starts[jnp.clip(dest, 0, P_ - 1)]
-        valid = (dest < P_) & (pos < cap) & (w > 0)
-        flat_pos = jnp.where(valid, jnp.clip(dest, 0, P_ - 1) * cap + pos, P_ * cap)
-
-        def scatter(vals, fill):
-            return jnp.full(P_ * cap, fill, vals.dtype).at[flat_pos].set(
-                vals, mode="drop"
-            )
-
-        send_cu = scatter(cu, 0).reshape(P_, cap)
-        send_cv = scatter(cv, 0).reshape(P_, cap)
-        send_w = scatter(w, 0).reshape(P_, cap)
-        r_cu = jax.lax.all_to_all(send_cu, AXIS, 0, 0, tiled=False).reshape(-1)
-        r_cv = jax.lax.all_to_all(send_cv, AXIS, 0, 0, tiled=False).reshape(-1)
-        r_w = jax.lax.all_to_all(send_w, AXIS, 0, 0, tiled=False).reshape(-1)
+    def body(cu, cv, w, cnt, wk, wv, wcnt):
+        idx = jax.lax.axis_index(AXIS)
+        send_cu = _pack(cu, cnt, cap, jnp.asarray(0, cu.dtype))
+        send_cv = _pack(cv, cnt, cap, jnp.asarray(0, cv.dtype))
+        send_w = _pack(w, cnt, cap, jnp.asarray(0, w.dtype))
+        r_cu = jax.lax.all_to_all(send_cu, AXIS, 0, 0).reshape(-1)
+        r_cv = jax.lax.all_to_all(send_cv, AXIS, 0, 0).reshape(-1)
+        r_w = jax.lax.all_to_all(send_w, AXIS, 0, 0).reshape(-1)
 
         # local aggregation by (cu_local, cv)
         S = r_cu.shape[0]  # P_ * cap
-        cu_l = r_cu - jax.lax.axis_index(AXIS) * n_loc_c
+        cu_l = r_cu - idx.astype(r_cu.dtype) * n_loc_c
         key_u = jnp.where(r_w > 0, cu_l, n_loc_c)  # drops sort last
         su, sv, sw = jax.lax.sort((key_u, r_cv, r_w), dimension=0, num_keys=2)
         first = run_starts2(su, sv)
@@ -136,70 +199,155 @@ def _s2(mesh, s_cu, s_cv, s_w, counts, *, num_shards: int, cap: int, n_loc_c: in
         out_u = jnp.zeros(S, su.dtype).at[pos2].set(su, mode="drop")
         out_v = jnp.zeros(S, sv.dtype).at[pos2].set(sv, mode="drop")
         out_w = jnp.zeros(S, sw.dtype).at[pos2].set(run_w, mode="drop")
-        return out_u, out_v, out_w, m_c_loc.astype(jnp.int32).reshape(1)
 
-    return body(s_cu, s_cv, s_w, counts)
+        # coarse node weights: aggregate received (compact id, weight) pairs
+        send_wk = _pack(wk, wcnt, cap_w, jnp.asarray(-1, wk.dtype))
+        send_wv = _pack(wv, wcnt, cap_w, jnp.asarray(0, wv.dtype))
+        r_wk = jax.lax.all_to_all(send_wk, AXIS, 0, 0).reshape(-1)
+        r_wv = jax.lax.all_to_all(send_wv, AXIS, 0, 0).reshape(-1)
+        wl = r_wk - idx.astype(r_wk.dtype) * n_loc_c
+        wok = (wl >= 0) & (wl < n_loc_c)
+        node_w_c = jax.ops.segment_sum(
+            jnp.where(wok, r_wv, 0),
+            jnp.clip(wl, 0, n_loc_c - 1).astype(jnp.int32),
+            num_segments=n_loc_c,
+        )
+        return out_u, out_v, out_w, m_c_loc.astype(jnp.int32).reshape(1), node_w_c
+
+    return body(s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts)
 
 
-@partial(jax.jit, static_argnames=("mesh", "m_loc_c", "n_loc_c"))
-def _s3(mesh, agg_u, agg_v, agg_w, c_node_w, *, m_loc_c: int, n_loc_c: int):
-    """Compact per-shard aggregated edges into the coarse DistGraph layout."""
+@partial(jax.jit, static_argnames=("mesh", "m_loc_c"))
+def _s4(mesh, agg_u, agg_v, agg_w, *, m_loc_c: int):
+    """Compact per-shard aggregated edges into the coarse layout."""
 
     @partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
     )
-    def body(u, v, w, cw_full):
-        idx = jax.lax.axis_index(AXIS)
-        eu = u[:m_loc_c]
-        cv = v[:m_loc_c]
-        ew = w[:m_loc_c]
-        nw = jax.lax.dynamic_slice(cw_full, (idx * n_loc_c,), (n_loc_c,))
-        return nw, eu, cv, ew
+    def body(u, v, w):
+        return u[:m_loc_c], v[:m_loc_c], w[:m_loc_c]
 
-    return body(agg_u, agg_v, agg_w, c_node_w)
+    return body(agg_u, agg_v, agg_w)
 
 
 def contract_dist_clustering(
-    mesh: Mesh, graph: DistGraph, labels
+    mesh: Mesh, graph: DistGraph, labels, cap_q: int | None = None
 ) -> Tuple[DistGraph, jax.Array, int]:
     """Contract a distributed clustering; returns (coarse graph, coarse_of,
-    n_c) where ``coarse_of`` is the (sharded) fine-node → coarse-id map used
-    by uncoarsening projection."""
+    n_c) where ``coarse_of`` holds each fine node's *global coarse id* (used
+    by uncoarsening projection; -1 on pad nodes)."""
     Pn = graph.num_shards
-    n_c, c_node_w, coarse_of, s_cu, s_cv, s_w, counts = _s1(
-        mesh, labels, graph.node_w, graph.edge_u, graph.col_idx, graph.edge_w,
-        num_shards=Pn,
-    )
+    n_loc = graph.n_loc
+    if cap_q is None:
+        cap_q = min(next_pow2(max(64, 2 * n_loc // Pn), 8), n_loc)
+
+    while True:
+        n_c, cw_own, cmap_own, ovf = _s1(
+            mesh, labels, graph.node_w, n_loc=n_loc, cap_q=cap_q
+        )
+        if int(ovf) == 0 or cap_q >= n_loc:
+            break
+        cap_q = min(cap_q * 2, n_loc)
     n_c = int(n_c)
     n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
-    cap = next_pow2(int(np.max(np.asarray(counts))), 8)
 
-    agg_u, agg_v, agg_w, m_c_loc = _s2(
-        mesh, s_cu, s_cv, s_w, counts, num_shards=Pn, cap=cap, n_loc_c=n_loc_c
+    cap_q2 = cap_q
+    while True:
+        (coarse_of, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts, ovf2) = _s2(
+            mesh, labels, cmap_own, cw_own, graph.edge_u, graph.col_loc,
+            graph.edge_w, graph.send_idx, graph.recv_map,
+            n_loc=n_loc, n_loc_c=n_loc_c, cap_q=cap_q2,
+        )
+        if int(ovf2) == 0 or cap_q2 >= n_loc + graph.g_loc:
+            break
+        cap_q2 = min(cap_q2 * 2, n_loc + graph.g_loc)
+
+    cap = next_pow2(int(np.max(np.asarray(counts))), 8)
+    cap_w = next_pow2(int(np.max(np.asarray(wcounts))), 8)
+
+    agg_u, agg_v, agg_w, m_c_loc, node_w_c = _s3(
+        mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts,
+        num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
     )
     m_loc_c = next_pow2(int(np.max(np.asarray(m_c_loc))), 8)
+    m_loc_c = min(m_loc_c, Pn * cap)  # aggregation buffer bound (ADVICE r1)
 
-    node_w_c, edge_u_c, col_c, edge_w_c = _s3(
-        mesh, agg_u, agg_v, agg_w, c_node_w, m_loc_c=m_loc_c, n_loc_c=n_loc_c
-    )
+    edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
+
+    # Host: localize edge targets + build the coarse ghost routing.  The
+    # edge sources out of _s3 are ALREADY shard-local (cu_l subtraction in
+    # the aggregation body) — do not localize them again.
     m_total = int(np.sum(np.asarray(m_c_loc)))
+    eu_l = np.asarray(edge_u_g).reshape(Pn, m_loc_c)
+    cv_g = np.asarray(col_g).reshape(Pn, m_loc_c)
+    w_np = np.asarray(edge_w_c).reshape(Pn, m_loc_c)
+    dtype = eu_l.dtype
+    col_shards = [cv_g[s] for s in range(Pn)]
+    valid_shards = [w_np[s] > 0 for s in range(Pn)]
+    send_idx, recv_map, ghost_global, cap_g, g_loc = build_ghost_exchange(
+        col_shards, valid_shards, n_loc_c, Pn, dtype=dtype
+    )
+    edge_u_c = np.where(w_np > 0, eu_l, 0)
+    col_loc_c = np.stack(
+        [
+            localize_columns(
+                cv_g[s], valid_shards[s], ghost_global[s], s, n_loc_c, g_loc,
+                dtype,
+            )
+            for s in range(Pn)
+        ]
+    )
+
     coarse = DistGraph(
-        node_w=node_w_c, edge_u=edge_u_c, col_idx=col_c, edge_w=edge_w_c,
-        n=n_c, m=m_total, n_loc=n_loc_c, m_loc=m_loc_c, num_shards=Pn,
+        node_w=node_w_c.reshape(-1),
+        edge_u=jnp.asarray(edge_u_c.reshape(-1)),
+        col_loc=jnp.asarray(col_loc_c.reshape(-1)),
+        edge_w=edge_w_c.reshape(-1),
+        send_idx=jnp.asarray(send_idx),
+        recv_map=jnp.asarray(recv_map),
+        ghost_global=tuple(ghost_global),
+        n=n_c,
+        m=m_total,
+        n_loc=n_loc_c,
+        m_loc=m_loc_c,
+        g_loc=g_loc,
+        cap_g=cap_g,
+        num_shards=Pn,
     )
     return coarse, coarse_of, n_c
 
 
-@partial(jax.jit, static_argnames=("mesh",))
-def project_partition_up(mesh, coarse_of, coarse_part):
-    """fine_part[u] = coarse_part[coarse_of[u]] across shards (reference:
-    uncoarsening projection, kaminpar-dist deep_multilevel.cc:347)."""
+def project_partition_up(mesh, coarse_of, coarse_part, *, n_loc_c: int,
+                         cap_q: int | None = None):
+    """fine_part[u] = coarse_part[coarse_of[u]] via owner-routed queries
+    (reference: uncoarsening projection, kaminpar-dist deep_multilevel.cc:347).
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS))
-    def body(c_of, c_part):
-        c_glob = jax.lax.all_gather(c_part, AXIS, tiled=True)
-        return c_glob[c_of]
+    ``coarse_part`` is (P*n_loc_c,)-sharded; no O(N) gather."""
+    n_loc_f = coarse_of.shape[0] // mesh.size
+    if cap_q is None:
+        cap_q = min(next_pow2(max(64, 2 * n_loc_f // mesh.size), 8), n_loc_f)
 
-    return body(coarse_of, coarse_part)
+    @partial(jax.jit, static_argnames=("cap",))
+    def run(c_of, c_part, *, cap):
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(AXIS), P(AXIS)), out_specs=(P(AXIS), P()),
+        )
+        def body(c_of_loc, c_part_loc):
+            drop = c_of_loc < 0
+            vals, ovf = owner_query(
+                c_of_loc, drop, c_part_loc, n_loc_c, cap,
+                fill=jnp.asarray(0, c_part_loc.dtype),
+            )
+            return jnp.where(drop, 0, vals), jax.lax.psum(ovf, AXIS)
+
+        return body(c_of, c_part)
+
+    while True:
+        out, ovf = run(coarse_of, coarse_part, cap=cap_q)
+        if int(ovf) == 0 or cap_q >= n_loc_f:
+            break
+        cap_q = min(cap_q * 2, n_loc_f)
+    return out

@@ -1,22 +1,32 @@
-"""Distributed CSR graph: 1D node-range sharding over a mesh axis.
+"""Distributed CSR graph: 1D node-range sharding with ghost nodes.
 
 TPU-native counterpart of ``DistributedCSRGraph``
-(kaminpar-dist/datastructures/distributed_csr_graph.h:39-100): node ranges are
-contiguous per shard (the reference's ``node_distribution[]`` prefix array);
-edges live with the owner of their source endpoint.  Instead of ghost-node
-remapping + growt hash maps, neighbor ids stay *global* and per-round label
-lookups read an all-gathered label table — the dense-exchange trade that fits
-XLA collectives (SURVEY §5 "Distributed communication backend").
+(kaminpar-dist/datastructures/distributed_csr_graph.h:39-100): node ranges
+are contiguous per shard (the reference's ``node_distribution[]`` prefix
+array); edges live with the owner of their source endpoint; off-shard
+neighbors are **ghost nodes** with per-shard local slots — the analog of
+``ghost_to_global[]``/``global_to_ghost`` (:39-100), built host-side instead
+of with growt hash maps.
+
+Edge targets are stored as *local slots* ``col_loc`` in
+``[0, n_loc + g_loc]``: ``< n_loc`` = local node, ``< n_loc + g_loc`` =
+ghost slot, ``== n_loc + g_loc`` = inert pad.  Per-round ghost values
+(labels, partitions) arrive via the static-routing sparse exchange in
+``exchange.py``, so per-device state is O(n_loc + m_loc + ghosts) — never
+O(N).
 
 Static-shape layout (SURVEY §7 hard part (d)):
-- ``n_loc = next_pow2(ceil((n+1)/P))`` nodes per shard; total padded node
-  space ``N = P * n_loc`` (> n always, so ``N-1`` is a global pad "anchor");
+- ``n_loc = next_pow2(ceil((n+1)/P))`` nodes per shard; padded global node
+  space ``N = P * n_loc`` (> n always);
 - ``m_loc = next_pow2(max shard edge count)`` edge slots per shard;
-- all arrays are flat ``(P * per_shard,)`` so ``PartitionSpec('nodes')``
-  splits them into per-shard blocks;
-- pad edge slots: ``u_local = 0``, ``col = N-1`` (anchor), ``w = 0`` (inert:
-  zero-rating runs are never candidates);
-- pad nodes: weight 0, no edges.
+- ``g_loc = next_pow2(max shard ghost count)`` ghost slots per shard;
+- flat ``(P * per_shard,)`` arrays so ``PartitionSpec('nodes')`` splits them
+  into per-shard blocks;
+- pad edge slots: ``u_local = 0``, ``col_loc = n_loc + g_loc``, ``w = 0``
+  (zero-rating runs are never move candidates).
+
+``dtype`` selects 32- vs 64-bit ids/weights (the reference's
+KAMINPAR_64BIT_* switches, CMakeLists.txt:71-79).
 """
 
 from __future__ import annotations
@@ -30,22 +40,29 @@ from functools import partial
 
 from ..graph.csr import CSRGraph
 from ..utils.intmath import next_pow2
+from .exchange import build_ghost_exchange, localize_columns
 
 _next_pow2 = partial(next_pow2, minimum=8)
 
 
 class DistGraph(NamedTuple):
-    """Host container of the sharded arrays (device placement happens when
-    the arrays enter a pjit/shard_map computation with a 'nodes' spec)."""
+    """Sharded device arrays + host metadata.  Device placement happens when
+    the arrays enter a pjit/shard_map computation with a 'nodes' spec; the
+    NamedTuple itself is never traced."""
 
     node_w: jax.Array  # (P * n_loc,) node weights, pads 0
     edge_u: jax.Array  # (P * m_loc,) LOCAL row index of the source
-    col_idx: jax.Array  # (P * m_loc,) GLOBAL neighbor id
+    col_loc: jax.Array  # (P * m_loc,) LOCAL target slot (node/ghost/pad)
     edge_w: jax.Array  # (P * m_loc,) weights, pads 0
+    send_idx: jax.Array  # (P * P, cap_g) ghost-exchange routing (owner side)
+    recv_map: jax.Array  # (P * g_loc,) ghost-exchange routing (ghost side)
+    ghost_global: tuple  # host: per-shard np arrays of ghost global ids
     n: int  # real node count
     m: int  # real (directed) edge count
     n_loc: int
     m_loc: int
+    g_loc: int
+    cap_g: int
     num_shards: int
 
     @property
@@ -54,11 +71,25 @@ class DistGraph(NamedTuple):
         return self.num_shards * self.n_loc
 
     @property
-    def anchor(self) -> int:
-        return self.N - 1
+    def dtype(self):
+        return self.node_w.dtype
+
+    @property
+    def max_per_shard_array(self) -> int:
+        """Largest per-shard device array the layout allocates — the
+        weak-scaling witness asserted in tests (must stay
+        O(n_loc + m_loc + ghosts), never O(N))."""
+        return max(
+            self.n_loc,
+            self.m_loc,
+            self.g_loc,
+            self.num_shards * self.cap_g,  # exchange buffers / routing
+        )
 
 
-def distribute_graph(graph: CSRGraph, num_shards: int) -> DistGraph:
+def distribute_graph(
+    graph: CSRGraph, num_shards: int, dtype=np.int32
+) -> DistGraph:
     """Split a host CSRGraph into ``num_shards`` contiguous node ranges.
 
     The reference distributes by node ranges too (dkaminpar.cc ``copy_graph``
@@ -67,47 +98,73 @@ def distribute_graph(graph: CSRGraph, num_shards: int) -> DistGraph:
     """
     P = num_shards
     rp = np.asarray(graph.row_ptr)
-    col = np.asarray(graph.col_idx).astype(np.int32)
-    ew = np.asarray(graph.edge_w).astype(np.int32)
-    nw = np.asarray(graph.node_w).astype(np.int32)
+    col = np.asarray(graph.col_idx).astype(dtype)
+    ew = np.asarray(graph.edge_w).astype(dtype)
+    nw = np.asarray(graph.node_w).astype(dtype)
     n, m = graph.n, graph.m
 
-    n_loc = _next_pow2((n + P) // P)  # ceil((n+1)/P) so N > n (global anchor)
+    n_loc = _next_pow2((n + P) // P)  # ceil((n+1)/P) so N > n
     N = P * n_loc
-    anchor = N - 1
 
     counts = [
         int(rp[min((s + 1) * n_loc, n)] - rp[min(s * n_loc, n)]) for s in range(P)
     ]
     m_loc = _next_pow2(max(max(counts), 1))
 
-    node_w = np.zeros(N, dtype=np.int32)
+    node_w = np.zeros(N, dtype=dtype)
     node_w[:n] = nw
-    edge_u = np.zeros(P * m_loc, dtype=np.int32)
-    col_idx = np.full(P * m_loc, anchor, dtype=np.int32)
-    edge_w = np.zeros(P * m_loc, dtype=np.int32)
+    edge_u = np.zeros(P * m_loc, dtype=dtype)
+    edge_w = np.zeros(P * m_loc, dtype=dtype)
 
     deg = np.diff(rp)
     src_global = np.repeat(np.arange(n, dtype=np.int64), deg)
+    col_global_per_shard, valid_per_shard = [], []
     for s in range(P):
         lo_node, hi_node = s * n_loc, min((s + 1) * n_loc, n)
-        if lo_node >= n:
-            continue
-        lo_e, hi_e = int(rp[lo_node]), int(rp[hi_node])
-        cnt = hi_e - lo_e
-        base = s * m_loc
-        edge_u[base : base + cnt] = (src_global[lo_e:hi_e] - lo_node).astype(np.int32)
-        col_idx[base : base + cnt] = col[lo_e:hi_e]
-        edge_w[base : base + cnt] = ew[lo_e:hi_e]
+        shard_col = np.zeros(m_loc, dtype=dtype)
+        shard_valid = np.zeros(m_loc, dtype=bool)
+        if lo_node < n:
+            lo_e, hi_e = int(rp[lo_node]), int(rp[hi_node])
+            cnt = hi_e - lo_e
+            base = s * m_loc
+            edge_u[base : base + cnt] = (src_global[lo_e:hi_e] - lo_node).astype(
+                dtype
+            )
+            edge_w[base : base + cnt] = ew[lo_e:hi_e]
+            shard_col[:cnt] = col[lo_e:hi_e]
+            shard_valid[:cnt] = ew[lo_e:hi_e] > 0
+        col_global_per_shard.append(shard_col)
+        valid_per_shard.append(shard_valid)
 
+    send_idx, recv_map, ghost_global, cap_g, g_loc = build_ghost_exchange(
+        col_global_per_shard, valid_per_shard, n_loc, P, dtype=dtype
+    )
+
+    # Rewrite edge targets to local slots.
+    col_loc = np.concatenate(
+        [
+            localize_columns(
+                col_global_per_shard[s], valid_per_shard[s], ghost_global[s],
+                s, n_loc, g_loc, dtype,
+            )
+            for s in range(P)
+        ]
+    )
+
+    jnp = jax.numpy
     return DistGraph(
-        node_w=jax.numpy.asarray(node_w),
-        edge_u=jax.numpy.asarray(edge_u),
-        col_idx=jax.numpy.asarray(col_idx),
-        edge_w=jax.numpy.asarray(edge_w),
+        node_w=jnp.asarray(node_w),
+        edge_u=jnp.asarray(edge_u),
+        col_loc=jnp.asarray(col_loc),
+        edge_w=jnp.asarray(edge_w),
+        send_idx=jnp.asarray(send_idx),
+        recv_map=jnp.asarray(recv_map),
+        ghost_global=tuple(ghost_global),
         n=n,
         m=m,
         n_loc=n_loc,
         m_loc=m_loc,
+        g_loc=g_loc,
+        cap_g=cap_g,
         num_shards=P,
     )

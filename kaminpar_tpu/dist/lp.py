@@ -1,20 +1,28 @@
-"""Distributed label propagation over a device mesh.
+"""Distributed label propagation over a device mesh — sparse-exchange design.
 
 The dKaMinPar global LP clusterer re-designed for SPMD/XLA
 (kaminpar-dist/coarsening/clustering/lp/global_lp_clusterer.cc): clusters may
-span shards; each round is bulk-synchronous —
+span shards; each round is bulk-synchronous.  Two entry points with different
+scaling regimes:
 
-1. every shard rates its local nodes' candidate clusters from the round-start
-   global label table (one ``all_gather`` over the mesh axis = the ghost-label
-   exchange, replacing ``sparse_alltoall_interface_to_pe``),
-2. global cluster weights are replicated via ``psum`` of shard-local
-   segment sums (replacing the growt global weight map, :437-525),
-3. moves commit **probabilistically** in proportion to the target cluster's
-   remaining capacity (the reference dist LP refiner's PROBABILISTIC
-   execution strategy, dkaminpar.h:116-120), then any cluster that still
-   ended up overweight has this round's in-moves rolled back — the strict
-   bulk-synchronous version of the reference's weight-rollback protocol
-   (global_lp_clusterer.cc:437-525).
+**Refinement** (labels = block ids, ``k`` small): block weights are a
+replicated ``(k,)`` table via ``psum`` — exactly the reference's replicated
+block weights (DistributedPartitionedGraph keeps all k block weights on
+every PE, distributed_partitioned_graph.h:15).  Ghost block ids arrive via
+the static sparse exchange.  Moves commit **probabilistically** in
+proportion to remaining capacity (the reference's PROBABILISTIC move
+execution, dkaminpar.h:116-120) with a rollback fixpoint.
+
+**Clustering** (labels = global cluster ids, up to N of them): no O(N)
+table anywhere.  Cluster weights live at the *owner shard* of each cluster
+id (owner = id // n_loc); each round aggregates weights to owners and runs
+an **owner-side capacity auction** (requests sorted by gain, prefix-sum
+admission against remaining capacity) — the deterministic bulk-synchronous
+analog of the reference's growt weight-delta rounds + rollback protocol
+(global_lp_clusterer.cc:437-525).  Per-device state is O(n_loc + m_loc +
+ghosts); owner-routed buffers use overflow-adaptive caps (re-run with a
+doubled cap on overflow; caps are bounded by n_loc thanks to local
+pre-aggregation).
 
 Everything here runs *inside* ``shard_map`` over mesh axis ``'nodes'``; the
 host-facing entry points build the shard_map closure for a given mesh.
@@ -29,32 +37,50 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.bucketed_gains import flat_best_moves, lookup
+from ..utils.intmath import next_pow2
+from .exchange import AXIS, ghost_exchange, owner_aggregate, pack_by_owner
 
-AXIS = "nodes"
+
+def _neighbor_labels(labels_loc, ghost_labels, col_loc, fill):
+    """Per-edge candidate labels from the local + ghost label table."""
+    ext = jnp.concatenate(
+        [labels_loc, ghost_labels, jnp.full((1,), fill, labels_loc.dtype)]
+    )
+    return ext[col_loc]
 
 
-def _round_body(key, labels_loc, node_w_loc, edge_u, col_idx, edge_w, max_w,
-                *, num_labels: int, external_only: bool):
-    """One bulk-synchronous LP round; runs per shard inside shard_map."""
+# ---------------------------------------------------------------------------
+# Refinement rounds: k block labels, replicated (k,) weight table.
+# ---------------------------------------------------------------------------
+
+
+def _refine_round_body(
+    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
+    recv_map, *, num_labels: int, external_only: bool
+):
+    """One bulk-synchronous LP refinement round; per shard inside shard_map."""
     idx = jax.lax.axis_index(AXIS)
     kshard = jax.random.fold_in(key, idx)
     kr, kp = jax.random.split(kshard)
     n_loc = labels_loc.shape[0]
 
-    # Ghost-label exchange: replicate the round-start label table.
-    labels_glob = jax.lax.all_gather(labels_loc, AXIS, tiled=True)
+    ghost_labels = ghost_exchange(
+        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
+    )
+    cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
 
     def global_weights(lab_loc):
         return jax.lax.psum(
-            jax.ops.segment_sum(node_w_loc, lab_loc, num_segments=num_labels), AXIS
+            jax.ops.segment_sum(
+                node_w_loc, lab_loc.astype(jnp.int32), num_segments=num_labels
+            ),
+            AXIS,
         )
 
     cluster_w = global_weights(labels_loc)
 
-    # Per-shard best moves: the shared flat kernel with candidate labels read
-    # from the gathered global table (ops/bucketed_gains.flat_best_moves).
     target, tconn, _, _ = flat_best_moves(
-        kr, edge_u, labels_glob[col_idx], edge_w, labels_loc, node_w_loc,
+        kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
         cluster_w, max_w, num_rows=n_loc,
         external_only=external_only, respect_caps=True,
     )
@@ -64,7 +90,9 @@ def _round_body(key, labels_loc, node_w_loc, edge_u, col_idx, edge_w, max_w,
     # Probabilistic commitment: accept ∝ remaining capacity / global demand.
     demand = jax.lax.psum(
         jax.ops.segment_sum(
-            jnp.where(mover, node_w_loc, 0), desired, num_segments=num_labels
+            jnp.where(mover, node_w_loc, 0),
+            desired.astype(jnp.int32),
+            num_segments=num_labels,
         ),
         AXIS,
     )
@@ -85,7 +113,9 @@ def _round_body(key, labels_loc, node_w_loc, edge_u, col_idx, edge_w, max_w,
         w = global_weights(jnp.where(kept, desired, labels_loc))
         arrivals = jax.lax.psum(
             jax.ops.segment_sum(
-                kept.astype(jnp.int32), desired, num_segments=num_labels
+                kept.astype(jnp.int32),
+                desired.astype(jnp.int32),
+                num_segments=num_labels,
             ),
             AXIS,
         )
@@ -107,20 +137,24 @@ def _round_body(key, labels_loc, node_w_loc, edge_u, col_idx, edge_w, max_w,
 
 
 def make_dist_lp_round(mesh: Mesh, *, num_labels: int, external_only: bool = False):
-    """Build the jitted one-round function for a mesh.
+    """Build the jitted one-round refinement function for a mesh.
 
     Takes/returns flat (P*n_loc,)-sharded label arrays; graph arrays are
-    (P*m_loc,)-sharded.  max_w may be a scalar or a (num_labels,) table."""
+    (P*m_loc,)-sharded; routing arrays per DistGraph.  max_w may be a scalar
+    or a (num_labels,) table."""
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
+                  P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P()),
     )
-    def round_fn(key, labels, node_w, edge_u, col_idx, edge_w, max_w):
-        return _round_body(
-            key, labels, node_w, edge_u, col_idx, edge_w, max_w,
+    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+                 send_idx, recv_map):
+        return _refine_round_body(
+            key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+            send_idx, recv_map,
             num_labels=num_labels, external_only=external_only,
         )
 
@@ -129,21 +163,147 @@ def make_dist_lp_round(mesh: Mesh, *, num_labels: int, external_only: bool = Fal
 
 def dist_lp_round(mesh, key, labels, graph, max_w, *, num_labels: int,
                   external_only: bool = False):
-    """Convenience one-round entry (builds + caches nothing; for tests)."""
+    """Convenience one-round refinement entry (for tests)."""
     fn = make_dist_lp_round(mesh, num_labels=num_labels, external_only=external_only)
-    return fn(key, labels, graph.node_w, graph.edge_u, graph.col_idx, graph.edge_w, max_w)
+    return fn(key, labels, graph.node_w, graph.edge_u, graph.col_loc,
+              graph.edge_w, max_w, graph.send_idx, graph.recv_map)
 
 
 def dist_lp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
                     num_rounds: int, external_only: bool = False):
-    """Fixed-round distributed LP loop (host loop; each round one dispatch)."""
+    """Fixed-round distributed LP refinement loop (one dispatch per round)."""
     fn = make_dist_lp_round(mesh, num_labels=num_labels, external_only=external_only)
     total = jnp.int32(0)
     for i in range(num_rounds):
         labels, moved = fn(
             jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
-            graph.col_idx, graph.edge_w, max_w,
+            graph.col_loc, graph.edge_w, max_w, graph.send_idx, graph.recv_map,
         )
+        total = total + moved
+    return labels, total
+
+
+# ---------------------------------------------------------------------------
+# Clustering rounds: global cluster ids, owner-side capacity auction.
+# ---------------------------------------------------------------------------
+
+
+def _cluster_round_body(
+    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
+    recv_map, *, cap_q: int
+):
+    """One clustering round with owner-auction admission; per shard."""
+    idx = jax.lax.axis_index(AXIS)
+    kr = jax.random.fold_in(key, idx)
+    n_loc = labels_loc.shape[0]
+    nshards = jax.lax.axis_size(AXIS)
+    base = idx.astype(labels_loc.dtype) * n_loc
+    real = node_w_loc > 0
+
+    ghost_labels = ghost_exchange(
+        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
+    )
+    cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
+
+    dummy = jnp.zeros((1,), node_w_loc.dtype)
+    target, tconn, own_conn, has = flat_best_moves(
+        kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
+        dummy, jnp.asarray(0, node_w_loc.dtype), num_rows=n_loc,
+        external_only=False, respect_caps=False,
+    )
+    desired = jnp.where(has, target, labels_loc)
+    gain = tconn - own_conn
+    mover = real & has & (desired != labels_loc)
+
+    # Cluster weights at owners (includes would-be movers at their source —
+    # conservative: admission never oversubscribes even if no one leaves).
+    cw_own, ovf_w = owner_aggregate(
+        labels_loc, node_w_loc, ~real, n_loc, cap_q
+    )
+
+    # Admission requests routed to the owner of the desired cluster.
+    key_buf, (w_buf, g_buf), flat_pos, ovf_a = pack_by_owner(
+        desired, ~mover, n_loc, cap_q,
+        jnp.where(mover, node_w_loc, 0), jnp.where(mover, gain, 0),
+    )
+    rk = jax.lax.all_to_all(key_buf, AXIS, 0, 0).reshape(-1)
+    rw = jax.lax.all_to_all(w_buf, AXIS, 0, 0).reshape(-1)
+    rg = jax.lax.all_to_all(g_buf, AXIS, 0, 0).reshape(-1)
+    S = rk.shape[0]  # nshards * cap_q
+
+    local = rk - base
+    ok = (local >= 0) & (local < n_loc) & (rw > 0)
+    sort_c = jnp.where(ok, local, n_loc).astype(jnp.int32)
+    ls, ng, ws, slot = jax.lax.sort(
+        (sort_c, -rg, rw, jnp.arange(S, dtype=jnp.int32)), dimension=0, num_keys=2
+    )
+    first = jnp.concatenate([jnp.ones(1, bool), ls[1:] != ls[:-1]])
+    c = jnp.cumsum(ws)
+    run_base = jax.lax.cummax(jnp.where(first, c - ws, 0))
+    cum_incl = c - run_base  # prefix weight within the cluster's run
+    remaining = lookup(max_w, jnp.clip(ls, 0, n_loc - 1)) - cw_own[
+        jnp.clip(ls, 0, n_loc - 1)
+    ]
+    accept_sorted = (ls < n_loc) & (ws > 0) & (cum_incl <= remaining)
+    accept_flat = jnp.zeros(S, bool).at[slot].set(accept_sorted)
+    back = jax.lax.all_to_all(accept_flat.reshape(nshards, cap_q), AXIS, 0, 0)
+    back_ext = jnp.concatenate([back.reshape(-1), jnp.zeros(1, bool)])
+    accepted = mover & back_ext[flat_pos]
+
+    final_labels = jnp.where(accepted, desired, labels_loc)
+    num_moved = jax.lax.psum(jnp.sum(accepted).astype(jnp.int32), AXIS)
+    overflow = jax.lax.psum(ovf_w + ovf_a, AXIS)
+    return final_labels, num_moved, overflow
+
+
+def make_dist_cluster_round(mesh: Mesh, *, cap_q: int):
+    """Build the jitted one-round clustering function (owner auction)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
+                  P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(), P()),
+    )
+    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+                 send_idx, recv_map):
+        return _cluster_round_body(
+            key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+            send_idx, recv_map, cap_q=cap_q,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_cluster_iterate(mesh, key, labels, graph, max_w, *, num_rounds: int,
+                         cap_q: int | None = None):
+    """Clustering LP loop with overflow-adaptive owner-buffer caps.
+
+    A round whose owner-routed buffers overflowed is *invalid* (dropped
+    weight contributions could oversubscribe clusters), so it is re-run with
+    the same key and a doubled cap; caps are bounded by n_loc.  Returns
+    (labels, total_moved).
+    """
+    n_loc = graph.n_loc
+    if cap_q is None:
+        cap_q = min(
+            next_pow2(max(64, 2 * n_loc // max(graph.num_shards, 1)), 8), n_loc
+        )
+    fn = make_dist_cluster_round(mesh, cap_q=cap_q)
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        while True:
+            out, moved, ovf = fn(
+                jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
+                graph.col_loc, graph.edge_w, max_w, graph.send_idx,
+                graph.recv_map,
+            )
+            if int(ovf) == 0 or cap_q >= n_loc:
+                break
+            cap_q = min(cap_q * 2, n_loc)
+            fn = make_dist_cluster_round(mesh, cap_q=cap_q)
+        labels = out
         total = total + moved
     return labels, total
 
@@ -156,7 +316,9 @@ def shard_arrays(mesh: Mesh, graph, labels):
         graph._replace(
             node_w=jax.device_put(graph.node_w, s),
             edge_u=jax.device_put(graph.edge_u, s),
-            col_idx=jax.device_put(graph.col_idx, s),
+            col_loc=jax.device_put(graph.col_loc, s),
             edge_w=jax.device_put(graph.edge_w, s),
+            send_idx=jax.device_put(graph.send_idx, s),
+            recv_map=jax.device_put(graph.recv_map, s),
         ),
     )

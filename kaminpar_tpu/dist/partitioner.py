@@ -8,8 +8,8 @@ shared-memory partitioner as initial partitioner**
 initial_partitioning/kaminpar_initial_partitioner.cc:63), then uncoarsen with
 distributed refinement.  Here "replicate to shm" = all-gather the coarse
 graph to host (the mesh-wide analog) and run the single-chip pipeline; the
-uncoarsening path projects partitions up across shards and refines with
-distributed LP rounds.
+uncoarsening path projects partitions up across shards (owner-routed
+queries, no O(N) gather) and refines with distributed LP rounds.
 """
 
 from __future__ import annotations
@@ -30,13 +30,14 @@ from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .contraction import contract_dist_clustering, project_partition_up
 from .graph import DistGraph, distribute_graph
-from .lp import dist_lp_iterate, shard_arrays
+from .lp import dist_cluster_iterate, dist_lp_iterate, shard_arrays
 
 
 @dataclass
 class _Level:
     graph: DistGraph
-    coarse_of: object  # sharded fine->coarse map
+    coarse_of: object  # sharded fine->coarse map (global coarse ids)
+    coarse_n_loc: int
 
 
 @dataclass
@@ -67,12 +68,17 @@ class DKaMinPar:
         ctx = self.ctx
         RandomState.reseed(ctx.seed)
         total_w = graph.total_node_weight
-        max_bw_val = int((1.0 + epsilon) * (total_w + k - 1) // k) + graph.max_node_weight
+        # Balance cap matches the shm/reference convention (kaminpar.py:96-99):
+        # max((1+eps)*ceil(W/k), ceil(W/k) + max_node_weight).
+        ceil_wk = (total_w + k - 1) // k
+        max_bw_val = max(
+            int((1.0 + epsilon) * ceil_wk), ceil_wk + graph.max_node_weight
+        )
         C = ctx.coarsening.contraction_limit
         target_n = max(2 * C, P * C // max(k, 1), 2 * k)
 
         dg = distribute_graph(graph, P)
-        labels = jnp.arange(dg.N, dtype=jnp.int32)
+        labels = jnp.arange(dg.N, dtype=dg.dtype)
         labels, dg = shard_arrays(self.mesh, dg, labels)
 
         # -- distributed coarsening ---------------------------------------
@@ -83,14 +89,22 @@ class DKaMinPar:
                 max_cw = max(
                     int(epsilon * total_w / max(min(cur.n // max(C, 1), k), 2)), 1
                 )
-                lab = jnp.arange(cur.N, dtype=jnp.int32)
+                lab = jnp.arange(cur.N, dtype=cur.dtype)
                 lab, cur = shard_arrays(self.mesh, cur, lab)
-                lab, _ = dist_lp_iterate(
-                    self.mesh, RandomState.next_key(), lab, cur, jnp.int32(max_cw),
-                    num_labels=cur.N,
+                lab, _ = dist_cluster_iterate(
+                    self.mesh, RandomState.next_key(), lab, cur,
+                    jnp.asarray(max_cw, cur.dtype),
                     num_rounds=ctx.coarsening.lp.num_iterations,
                 )
                 coarse, coarse_of, n_c = contract_dist_clustering(self.mesh, cur, lab)
+                if n_c < k:
+                    # contraction overshot below k blocks — keep the finer
+                    # graph so initial partitioning can still produce k
+                    Logger.log(
+                        f"  dist coarsening stopped: n_c={n_c} < k={k}",
+                        OutputLevel.DEBUG,
+                    )
+                    break
                 shrink = 1.0 - n_c / max(cur.n, 1)
                 Logger.log(
                     f"  dist coarsening: n={cur.n} -> {n_c} (m={cur.m} -> {coarse.m})",
@@ -98,7 +112,7 @@ class DKaMinPar:
                 )
                 if shrink < ctx.coarsening.convergence_threshold:
                     break
-                self.hierarchy.append(_Level(cur, coarse_of))
+                self.hierarchy.append(_Level(cur, coarse_of, coarse.n_loc))
                 cur = coarse
 
         # -- initial partitioning: replicate coarsest -> shm pipeline ------
@@ -108,19 +122,27 @@ class DKaMinPar:
 
             shm = KaMinPar(self.ctx)
             shm.set_graph(coarse_host)
-            part_host = shm.compute_partition(k=max(min(k, coarse_host.n), 1), epsilon=epsilon)
+            k0 = max(min(k, coarse_host.n), 1)
+            if k0 < k:
+                Logger.log(
+                    f"dist initial partitioning: coarsest n={coarse_host.n} < "
+                    f"k={k}, using k'={k0}",
+                    OutputLevel.WARNING,
+                )
+            part_host = shm.compute_partition(k=k0, epsilon=epsilon)
             part = np.zeros(cur.N, dtype=np.int32)
             part[: cur.n] = part_host
 
         # -- uncoarsening + distributed refinement -------------------------
-        cap = jnp.full(k, max_bw_val, dtype=jnp.int32)
+        cap = jnp.full(k, max_bw_val, dtype=dg.dtype)
         with scoped_timer("dist_uncoarsening"):
             part_dev, cur_shard = shard_arrays(self.mesh, cur, jnp.asarray(part))
             part_dev = self._refine(part_dev, cur_shard, cap, k)
             while self.hierarchy:
                 level = self.hierarchy.pop()
                 part_dev = project_partition_up(
-                    self.mesh, level.coarse_of, part_dev
+                    self.mesh, level.coarse_of, part_dev,
+                    n_loc_c=level.coarse_n_loc,
                 )
                 part_dev = self._refine(part_dev, level.graph, cap, k)
 
@@ -146,13 +168,24 @@ class DKaMinPar:
         mesh and rebuild a host CSRGraph (reference: replicator.h:26)."""
         node_w = np.asarray(dg.node_w)[: dg.n]
         eu_loc = np.asarray(dg.edge_u).reshape(dg.num_shards, dg.m_loc)
-        cv = np.asarray(dg.col_idx).reshape(dg.num_shards, dg.m_loc)
+        cl = np.asarray(dg.col_loc).reshape(dg.num_shards, dg.m_loc)
         w = np.asarray(dg.edge_w).reshape(dg.num_shards, dg.m_loc)
         srcs, dsts, ws = [], [], []
         for s in range(dg.num_shards):
             real = w[s] > 0
             srcs.append(eu_loc[s][real] + s * dg.n_loc)
-            dsts.append(cv[s][real])
+            # localize: slots < n_loc are shard-local nodes, others ghosts
+            slots = cl[s][real]
+            gg = dg.ghost_global[s]
+            is_local = slots < dg.n_loc
+            dst = np.where(
+                is_local,
+                slots + s * dg.n_loc,
+                gg[np.clip(slots - dg.n_loc, 0, max(len(gg) - 1, 0))]
+                if len(gg)
+                else 0,
+            )
+            dsts.append(dst)
             ws.append(w[s][real])
         src = np.concatenate(srcs)
         dst = np.concatenate(dsts)

@@ -7,7 +7,12 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from kaminpar_tpu.dist import distribute_graph, dist_lp_iterate, dist_lp_round
+from kaminpar_tpu.dist import (
+    dist_cluster_iterate,
+    dist_lp_iterate,
+    dist_lp_round,
+    distribute_graph,
+)
 from kaminpar_tpu.dist.lp import shard_arrays
 from kaminpar_tpu.graph import generators, metrics
 
@@ -27,27 +32,39 @@ def test_distribute_graph_layout():
     # matches the original.
     assert int(np.asarray(dg.edge_w).sum()) == g.total_edge_weight
     assert int(np.asarray(dg.node_w).sum()) == g.total_node_weight
-    # reconstruct global sources and check endpoints are real nodes
+    # real edge targets are valid local or ghost slots, pads point at the
+    # pad slot
     eu = np.asarray(dg.edge_u).reshape(4, dg.m_loc)
     ew = np.asarray(dg.edge_w).reshape(4, dg.m_loc)
-    ci = np.asarray(dg.col_idx).reshape(4, dg.m_loc)
+    cl = np.asarray(dg.col_loc).reshape(4, dg.m_loc)
     for s in range(4):
         real = ew[s] > 0
-        assert np.all(ci[s][real] < g.n)
+        assert np.all(cl[s][real] < dg.n_loc + len(dg.ghost_global[s]))
+        assert np.all(cl[s][~real] == dg.n_loc + dg.g_loc)
         assert np.all(eu[s][real] < dg.n_loc)
 
 
-def test_dist_lp_clustering_round():
+def test_distribute_graph_int64():
+    # 64-bit ids/weights (the reference's KAMINPAR_64BIT_* switches) require
+    # jax x64 mode, the runtime analog of the build flag.
+    with jax.enable_x64(True):
+        g = generators.grid2d_graph(6, 6)
+        dg = distribute_graph(g, 4, dtype=np.int64)
+        assert str(dg.node_w.dtype) == "int64"
+        assert str(dg.col_loc.dtype) == "int64"
+        assert int(np.asarray(dg.edge_w).sum()) == g.total_edge_weight
+
+
+def test_dist_cluster_round():
     mesh = _mesh()
     g = generators.grid2d_graph(16, 16)
     dg = distribute_graph(g, mesh.size)
     N = dg.N
     labels = jnp.arange(N, dtype=jnp.int32)
     labels, dg = shard_arrays(mesh, dg, labels)
-    max_w = jnp.int32(8)
 
-    out, moved = dist_lp_round(
-        mesh, jax.random.key(0), labels, dg, max_w, num_labels=N
+    out, moved = dist_cluster_iterate(
+        mesh, jax.random.key(0), labels, dg, jnp.int32(8), num_rounds=1
     )
     out = np.asarray(out)
     assert int(moved) > 0
@@ -58,16 +75,15 @@ def test_dist_lp_clustering_round():
     assert np.all(out[g.n :] == np.arange(g.n, N))
 
 
-def test_dist_lp_iterate_coarsens():
+def test_dist_cluster_iterate_coarsens():
     mesh = _mesh()
     g = generators.rmat_graph(10, 8, seed=3)
     dg = distribute_graph(g, mesh.size)
     N = dg.N
     labels = jnp.arange(N, dtype=jnp.int32)
     labels, dg = shard_arrays(mesh, dg, labels)
-    out, total = dist_lp_iterate(
-        mesh, jax.random.key(1), labels, dg, jnp.int32(64), num_labels=N,
-        num_rounds=5,
+    out, total = dist_cluster_iterate(
+        mesh, jax.random.key(1), labels, dg, jnp.int32(64), num_rounds=5
     )
     out = np.asarray(out)[: g.n]
     clusters = len(np.unique(out))
@@ -76,10 +92,10 @@ def test_dist_lp_iterate_coarsens():
     assert w.max() <= 64
 
 
-def test_rollback_cascade_keeps_feasibility():
-    """A rolled-back out-move returns weight to its source cluster, which may
-    itself tip overweight — the rollback must iterate to a fixpoint (review
-    finding: single-pass rollback violated the cap on ~3% of seeds)."""
+def test_cluster_auction_keeps_feasibility():
+    """The owner-side capacity auction must never admit weight beyond the
+    cluster cap, across seeds (the reference's growt weight-rollback
+    protocol analog, global_lp_clusterer.cc:437-525)."""
     mesh = _mesh()
     g = generators.rmat_graph(9, 6, seed=11)
     dg = distribute_graph(g, mesh.size)
@@ -88,9 +104,9 @@ def test_rollback_cascade_keeps_feasibility():
     for seed in range(20):
         labels = jnp.arange(N, dtype=jnp.int32)
         labels, dgs = shard_arrays(mesh, dg, labels)
-        out, _ = dist_lp_iterate(
+        out, _ = dist_cluster_iterate(
             mesh, jax.random.key(seed), labels, dgs, jnp.int32(cap),
-            num_labels=N, num_rounds=3,
+            num_rounds=3,
         )
         w = np.bincount(np.asarray(out)[: g.n], minlength=N)
         assert w.max() <= cap, f"seed {seed}: cluster weight {w.max()} > {cap}"
@@ -117,3 +133,34 @@ def test_dist_lp_refinement_improves_cut():
     assert cut1 < cut0  # refinement reduces the cut
     w = np.bincount(out, weights=np.ones(g.n), minlength=k)
     assert w.max() <= int(1.1 * g.total_node_weight / k) + 8
+
+
+def test_per_shard_memory_stays_local():
+    """Weak-scaling witness (VERDICT r1 weak #3): per-shard arrays are
+    O(n_loc + m_loc + ghosts), never O(N).  On an rmat scale-14 graph over 8
+    shards no per-shard device array may exceed ~2*(n_loc + m_loc)."""
+    mesh = _mesh()
+    g = generators.rmat_graph(14, 8, seed=5)
+    dg = distribute_graph(g, mesh.size)
+    bound = 2 * (dg.n_loc + dg.m_loc)
+    assert dg.max_per_shard_array <= bound, (
+        f"per-shard array {dg.max_per_shard_array} exceeds 2*(n_loc+m_loc)="
+        f"{bound}"
+    )
+    # and the ghost/exchange structures specifically
+    assert dg.g_loc <= dg.m_loc
+    assert dg.num_shards * dg.cap_g <= bound
+
+    # one clustering round runs without the owner buffers blowing past the
+    # bound either (cap_q * P <= 2*(n_loc+m_loc))
+    labels = jnp.arange(dg.N, dtype=jnp.int32)
+    labels, dgs = shard_arrays(mesh, dg, labels)
+    from kaminpar_tpu.utils.intmath import next_pow2
+
+    cap_q = min(next_pow2(max(64, 2 * dg.n_loc // dg.num_shards), 8), dg.n_loc)
+    assert dg.num_shards * cap_q <= bound
+    out, moved = dist_cluster_iterate(
+        mesh, jax.random.key(0), labels, dgs, jnp.int32(64), num_rounds=2,
+        cap_q=cap_q,
+    )
+    assert int(moved) > 0

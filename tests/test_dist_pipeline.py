@@ -59,6 +59,34 @@ def test_dist_contraction_matches_host():
     c_of = np.asarray(coarse_of)[: g.n]
     np.testing.assert_array_equal(c_of, host_of)
 
+    # exact coarse edge set: reconstruct (cu, cv, w) from the dist layout
+    # (edge_u is shard-local, col_loc is a local/ghost slot) and compare
+    # with the host coarse CSR triples
+    eu = np.asarray(coarse.edge_u).reshape(coarse.num_shards, coarse.m_loc)
+    cl = np.asarray(coarse.col_loc).reshape(coarse.num_shards, coarse.m_loc)
+    w = np.asarray(coarse.edge_w).reshape(coarse.num_shards, coarse.m_loc)
+    got = set()
+    for s in range(coarse.num_shards):
+        real = w[s] > 0
+        gg = coarse.ghost_global[s]
+        for u_l, slot, ew in zip(eu[s][real], cl[s][real], w[s][real]):
+            u = int(u_l) + s * coarse.n_loc
+            v = (
+                int(slot) + s * coarse.n_loc
+                if slot < coarse.n_loc
+                else int(gg[slot - coarse.n_loc])
+            )
+            got.add((u, v, int(ew)))
+    rp = np.asarray(host_coarse.row_ptr)
+    hc = np.asarray(host_coarse.col_idx)
+    hw = np.asarray(host_coarse.edge_w)
+    want = {
+        (u, int(hc[e]), int(hw[e]))
+        for u in range(host_coarse.n)
+        for e in range(int(rp[u]), int(rp[u + 1]))
+    }
+    assert got == want
+
 
 def test_project_partition_up():
     mesh = _mesh()
@@ -72,7 +100,9 @@ def test_project_partition_up():
     rng = np.random.default_rng(1)
     cpart = rng.integers(0, 4, coarse.N).astype(np.int32)
     cpart_dev, _ = shard_arrays(mesh, coarse, jnp.asarray(cpart))
-    fine = np.asarray(project_partition_up(mesh, coarse_of, cpart_dev))
+    fine = np.asarray(
+        project_partition_up(mesh, coarse_of, cpart_dev, n_loc_c=coarse.n_loc)
+    )
     c_of = np.asarray(coarse_of)
     np.testing.assert_array_equal(fine[: g.n], cpart[c_of[: g.n]])
 
