@@ -30,7 +30,7 @@ host-facing entry points build the shard_map closure for a given mesh.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -136,6 +136,7 @@ def _refine_round_body(
     return final_labels, num_moved
 
 
+@lru_cache(maxsize=None)
 def make_dist_lp_round(mesh: Mesh, *, num_labels: int, external_only: bool = False):
     """Build the jitted one-round refinement function for a mesh.
 
@@ -256,6 +257,7 @@ def _cluster_round_body(
     return final_labels, num_moved, overflow
 
 
+@lru_cache(maxsize=None)
 def make_dist_cluster_round(mesh: Mesh, *, cap_q: int):
     """Build the jitted one-round clustering function (owner auction)."""
 

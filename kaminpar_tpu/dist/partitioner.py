@@ -28,6 +28,7 @@ from ..graph import metrics
 from ..utils import RandomState
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
+from .balancer import dist_balance
 from .contraction import contract_dist_clustering, project_partition_up
 from .graph import DistGraph, distribute_graph
 from .lp import dist_cluster_iterate, dist_lp_iterate, shard_arrays
@@ -155,7 +156,18 @@ class DKaMinPar:
         return out
 
     def _refine(self, part, dgraph: DistGraph, cap, k: int):
+        """Balance → LP, the reference's refiner pipeline order
+        (dist factories.cc:95-131: NodeBalancer runs before LP/CLP/JET)."""
         part, dgraph = shard_arrays(self.mesh, dgraph, part)
+        part, feasible = dist_balance(
+            self.mesh, RandomState.next_key(), part, dgraph, cap, k=k
+        )
+        if not feasible:
+            Logger.log(
+                "dist balancer exhausted its round budget without restoring "
+                "feasibility; the returned partition may exceed block caps",
+                OutputLevel.WARNING,
+            )
         out, _ = dist_lp_iterate(
             self.mesh, RandomState.next_key(), part, dgraph, cap,
             num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
