@@ -118,6 +118,9 @@ class InitialPartitioningContext:
     # 2-way FM refinement of each bipartition.
     fm_num_iterations: int = 5
     fm_alpha: float = 1.0  # adaptive stopping alpha (Osipov/Sanders)
+    # Sequential mini-multilevel inside each bisection (reference:
+    # initial_multilevel_bipartitioner.cc:67-74, C=20).
+    coarsening_contraction_limit: int = 20
 
 
 @dataclass
