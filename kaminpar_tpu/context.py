@@ -121,6 +121,15 @@ class InitialPartitioningContext:
     # Sequential mini-multilevel inside each bisection (reference:
     # initial_multilevel_bipartitioner.cc:67-74, C=20).
     coarsening_contraction_limit: int = 20
+    # Shrink factor below which IP coarsening is considered converged
+    # (reference: InitialCoarseningContext::convergence_threshold = 0.05).
+    coarsening_convergence_threshold: float = 0.05
+    # Up to this finest-graph size, also run the flat pool on the finest
+    # graph and keep the better of {mini-ML, flat} — measured divergence
+    # from the reference (which always uses ML): on expander-like coarse
+    # graphs (RMAT) flat pool+FM beats the projected ML partition, while
+    # on geometric/mesh graphs ML wins; best-of is cheap at this size.
+    flat_pool_fallback_n: int = 2048
 
 
 @dataclass

@@ -230,6 +230,11 @@ def _lp_cluster_seq(
     Reference: ``initial_partitioning/coarsening/initial_coarsener.cc`` — the
     IP tier coarsens with a *sequential* LP whose immediate label updates
     converge much faster than Jacobi rounds on the tiny graphs seen here.
+    Isolated (degree-0) nodes can never merge through ratings, so they are
+    bin-packed into joint clusters afterwards (the analog of the main LP
+    engine's isolated-node pass, label_propagation.h two-hop/isolated
+    handling); without this, graphs with many isolated nodes — e.g. RMAT —
+    stall far above the contraction limit.
     """
     n = g.n
     labels = np.arange(n, dtype=np.int64)
@@ -261,6 +266,16 @@ def _lp_cluster_seq(
                 moved += 1
         if moved == 0:
             break
+
+    # Bin-pack isolated nodes into joint clusters up to max_cw.
+    isolated = np.flatnonzero((np.diff(g.row_ptr) == 0) & (labels == np.arange(n)))
+    cur_label, cur_w = -1, 0
+    for u in isolated:
+        w_u = int(g.node_w[u])
+        if cur_label < 0 or cur_w + w_u > max_cw:
+            cur_label, cur_w = int(u), 0
+        labels[u] = cur_label
+        cur_w += w_u
     return labels
 
 
@@ -297,9 +312,10 @@ def multilevel_bipartition(
     """Sequential mini-multilevel bipartitioning: LP-coarsen → pool
     bipartition → uncoarsen with 2-way FM at every level.
 
-    Reference: ``initial_multilevel_bipartitioner.cc:67-74`` (coarsen to
-    2·C with C=20, adaptive repetition count growing with the final block
-    count this bisection serves) + ``initial_coarsener.cc``.  The mini-ML
+    Reference: ``initial_multilevel_bipartitioner.cc:118-157`` (coarsen
+    while shrinking ≥5%/level down to the contraction limit C=20, adaptive
+    repetition count growing with the final block count this bisection
+    serves) + ``initial_coarsener.cc``.  The mini-ML
     gives the FM a hierarchy to work through, which flat pool+FM cannot
     match on non-trivial coarse graphs (VERDICT r1 missing #8).
     """
@@ -307,15 +323,18 @@ def multilevel_bipartition(
     C = ctx.coarsening_contraction_limit
     total = g.total_node_weight
 
+    # Max cluster weight: the reference IP coarsener uses the BLOCK_WEIGHT
+    # limit with multiplier 1/12 (presets.cc:195-196 via
+    # max_cluster_weights.h:32-34), computed once from the finest graph.
+    eps = max(float(max_w.sum()) / max(total, 1) - 1.0, 0.0)
+    max_cw = max(int((1.0 + eps) * total / 2 / 12), 1)
+
     hierarchy: list = []
     cur = g
-    while cur.n > 2 * C:
-        # max cluster weight: the IP coarsener's eps-share formula
-        # (max_cluster_weights.h shape, with the bisection's own budget)
-        max_cw = max(int(0.25 * total / max(cur.n / max(C, 1), 2)), 1)
+    while cur.n > C:
         labels = _lp_cluster_seq(cur, max_cw, rng)
         coarse, cmap = _contract_host(cur, labels)
-        if coarse.n >= 0.95 * cur.n:
+        if coarse.n >= (1.0 - ctx.coarsening_convergence_threshold) * cur.n:
             break
         hierarchy.append((cur, cmap))
         cur = coarse
@@ -340,6 +359,20 @@ def multilevel_bipartition(
         part = _fm_refine_2way(
             fine, part, max_w, rng, ctx.fm_num_iterations, ctx.fm_alpha
         )
+
+    # Best-of safeguard (divergence from the reference, which always uses
+    # the ML partition): on expander-like graphs the projected ML partition
+    # is a worse FM basin than a flat start, so for small finest graphs run
+    # the flat pool too and keep the better result.
+    if hierarchy and g.n <= ctx.flat_pool_fallback_n:
+        flat = pool_bipartition(g, max_w, rng, reps_ctx)
+
+        def _score(p):
+            bw = _block_weights(g, p)
+            return (bool((bw <= max_w).all()), -_cut(g, p))
+
+        if _score(flat) > _score(part):
+            part = flat
     return part
 
 
