@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("-e", "--epsilon", type=float, default=0.03,
                    help="max block-weight imbalance factor (default 0.03)")
+    p.add_argument("--min-epsilon", type=float, default=0.0,
+                   help="max allowed imbalance for minimum block weights; 0 "
+                        "disables minimum weights (default)")
     p.add_argument("-f", "--format", default=None, choices=["metis", "parhip"],
                    help="input format (default: auto-detect)")
     p.add_argument("-o", "--output", default=None, help="partition output file")
@@ -76,7 +79,9 @@ def main(argv=None) -> int:
 
     solver = KaMinPar(ctx)
     solver.set_graph(graph)
-    part = solver.compute_partition(k=args.k, epsilon=args.epsilon)
+    part = solver.compute_partition(
+        k=args.k, epsilon=args.epsilon, min_epsilon=args.min_epsilon
+    )
 
     p_graph = solver.last_partition
     Logger.log(

@@ -39,6 +39,7 @@ class RefinementAlgorithm(enum.Enum):
     LP = "lp"
     JET = "jet"
     OVERLOAD_BALANCER = "overload-balancer"
+    UNDERLOAD_BALANCER = "underload-balancer"
     GREEDY_BALANCER = "greedy-balancer"  # alias used by some presets
 
 
@@ -175,21 +176,37 @@ class PartitionContext:
 
     k: int = 2
     epsilon: float = 0.03
+    # Minimum block-weight imbalance; 0 disables minimum weights (reference:
+    # KaMinPar::set_uniform_min_block_weights, kaminpar.cc:266-269).
+    min_epsilon: float = 0.0
     # Filled by setup():
     total_node_weight: int = 0
     max_block_weights: Optional[object] = None  # np.ndarray[k], set by setup()
+    min_block_weights: Optional[object] = None  # np.ndarray[k] or None
 
-    def setup(self, total_node_weight: int, k: int, epsilon: float) -> None:
+    def setup(
+        self, total_node_weight: int, k: int, epsilon: float, min_epsilon: float = 0.0
+    ) -> None:
+        import math
+
         import numpy as np
 
         self.k = int(k)
         self.epsilon = float(epsilon)
+        self.min_epsilon = float(min_epsilon)
         self.total_node_weight = int(total_node_weight)
         perfect = (total_node_weight + k - 1) // k
         max_bw = int((1.0 + epsilon) * perfect)
         # Strict balance for unweighted graphs requires max >= perfect + max
         # node weight; the facade adjusts for node weights (kaminpar.cc).
         self.max_block_weights = np.full(k, max(max_bw, perfect + 1), dtype=np.int64)
+        if min_epsilon > 0.0:
+            # min_bw = ceil((1 - min_eps) * perfect) (context.cc:72-81)
+            self.min_block_weights = np.full(
+                k, int(math.ceil((1.0 - min_epsilon) * perfect)), dtype=np.int64
+            )
+        else:
+            self.min_block_weights = None
 
 
 @dataclass

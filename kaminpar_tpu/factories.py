@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from .context import Context, PartitioningMode, RefinementAlgorithm
 from .graph.csr import CSRGraph
-from .refinement.balancer import OverloadBalancer
+from .refinement.balancer import OverloadBalancer, UnderloadBalancer
 from .refinement.jet import JetRefiner
 from .refinement.lp_refiner import LPRefiner
 from .refinement.refiner import MultiRefiner, NoopRefiner, Refiner
@@ -27,6 +27,8 @@ def create_refiner(ctx: Context, *, coarse_level: bool = False) -> Refiner:
             RefinementAlgorithm.GREEDY_BALANCER,
         ):
             refiners.append(OverloadBalancer(ctx.refinement.balancer))
+        elif algo == RefinementAlgorithm.UNDERLOAD_BALANCER:
+            refiners.append(UnderloadBalancer(ctx.refinement.balancer))
         elif algo == RefinementAlgorithm.JET:
             refiners.append(
                 JetRefiner(ctx.refinement.jet, ctx.refinement.balancer, coarse_level=coarse_level)

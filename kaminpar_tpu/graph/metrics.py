@@ -65,3 +65,15 @@ def total_overload(graph: CSRGraph, partition, k: int, max_block_weights) -> int
 def is_feasible(graph: CSRGraph, partition, k: int, max_block_weights) -> bool:
     """All block weights within limits (reference: ``metrics::is_feasible``)."""
     return total_overload(graph, partition, k, max_block_weights) == 0
+
+
+def total_underload(graph: CSRGraph, partition, k: int, min_block_weights) -> int:
+    """Sum of weight missing below the per-block minimums (metrics.h)."""
+    bw = np.asarray(block_weights(graph, partition, k))
+    return int(np.maximum(np.asarray(min_block_weights, dtype=np.int64) - bw, 0).sum())
+
+
+def is_min_feasible(graph: CSRGraph, partition, k: int, min_block_weights) -> bool:
+    """All block weights at or above the minimums (reference:
+    ``metrics::is_min_balanced``, metrics.h:74)."""
+    return total_underload(graph, partition, k, min_block_weights) == 0

@@ -25,14 +25,24 @@ class PartitionedGraph:
     k: int
     partition: object  # (n,) int array of block ids
     max_block_weights: object  # (k,) int64 host array
+    # Optional minimum block weights (reference: PartitionContext min block
+    # weights, enforced by the underload balancer; None = unconstrained).
+    min_block_weights: object = None
 
     @classmethod
-    def create(cls, graph: CSRGraph, k: int, partition, max_block_weights) -> "PartitionedGraph":
+    def create(
+        cls, graph: CSRGraph, k: int, partition, max_block_weights, min_block_weights=None
+    ) -> "PartitionedGraph":
         return cls(
             graph=graph,
             k=int(k),
             partition=jnp.asarray(partition),
             max_block_weights=np.asarray(max_block_weights, dtype=np.int64),
+            min_block_weights=(
+                None
+                if min_block_weights is None
+                else np.asarray(min_block_weights, dtype=np.int64)
+            ),
         )
 
     def block_weights(self):
@@ -47,5 +57,18 @@ class PartitionedGraph:
     def is_feasible(self) -> bool:
         return metrics.is_feasible(self.graph, self.partition, self.k, self.max_block_weights)
 
+    def is_min_feasible(self) -> bool:
+        if self.min_block_weights is None:
+            return True
+        return metrics.is_min_feasible(
+            self.graph, self.partition, self.k, self.min_block_weights
+        )
+
     def with_partition(self, partition) -> "PartitionedGraph":
-        return PartitionedGraph(self.graph, self.k, jnp.asarray(partition), self.max_block_weights)
+        return PartitionedGraph(
+            self.graph,
+            self.k,
+            jnp.asarray(partition),
+            self.max_block_weights,
+            self.min_block_weights,
+        )

@@ -68,13 +68,17 @@ class KaMinPar:
         k: int,
         epsilon: float = 0.03,
         max_block_weights: Optional[Sequence[int]] = None,
+        min_epsilon: float = 0.0,
+        min_block_weights: Optional[Sequence[int]] = None,
     ) -> np.ndarray:
         """Partition into k blocks; returns the (n,) block-id array.
 
         Balance constraint: per-block weight <=
         ``max((1+epsilon)*ceil(W/k), ceil(W/k) + max_node_weight)`` (the
         reference's setup, kaminpar.cc:315-331), or explicit absolute budgets
-        via ``max_block_weights``.
+        via ``max_block_weights``.  Minimum block weights (enforced by the
+        underload balancer) via ``min_epsilon`` (reference:
+        ``set_uniform_min_block_weights``) or absolute ``min_block_weights``.
         """
         assert self.graph is not None, "call set_graph/copy_graph first"
         graph = self.graph
@@ -88,7 +92,7 @@ class KaMinPar:
         Timer.reset_global()
         start = time.perf_counter()
 
-        ctx.partition.setup(graph.total_node_weight, k, epsilon)
+        ctx.partition.setup(graph.total_node_weight, k, epsilon, min_epsilon)
         if max_block_weights is not None:
             ctx.partition.max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
         else:
@@ -97,10 +101,13 @@ class KaMinPar:
             ctx.partition.max_block_weights = np.maximum(
                 ctx.partition.max_block_weights, perfect + graph.max_node_weight
             )
+        if min_block_weights is not None:
+            ctx.partition.min_block_weights = np.asarray(min_block_weights, dtype=np.int64)
 
         if graph.n == 0:
             self._last = PartitionedGraph.create(
-                graph, k, np.zeros(0, dtype=np.int32), ctx.partition.max_block_weights
+                graph, k, np.zeros(0, dtype=np.int32),
+                ctx.partition.max_block_weights, ctx.partition.min_block_weights,
             )
             return np.zeros(0, dtype=np.int32)
 

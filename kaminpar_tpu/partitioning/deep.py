@@ -71,7 +71,15 @@ class DeepMultilevelPartitioner:
         max_bw = intermediate_block_weights(
             np.asarray(self.ctx.partition.max_block_weights, dtype=np.int64), cur_k
         )
-        p_graph = PartitionedGraph.create(graph, cur_k, part, max_bw)
+        # Minimum block weights apply once the partition carries the final k
+        # (intermediate blocks merge several final blocks; their minimums
+        # would over-constrain refinement).
+        min_bw = (
+            self.ctx.partition.min_block_weights
+            if cur_k == self.ctx.partition.k
+            else None
+        )
+        p_graph = PartitionedGraph.create(graph, cur_k, part, max_bw, min_bw)
         refiner = create_refiner(self.ctx, coarse_level=coarse)
         return refiner.refine(p_graph)
 

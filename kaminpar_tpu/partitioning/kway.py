@@ -66,7 +66,8 @@ class KWayMultilevelPartitioner:
 
             part = initial_partition(coarsest, ctx)
             p_graph = PartitionedGraph.create(
-                coarsest, k, part, ctx.partition.max_block_weights
+                coarsest, k, part, ctx.partition.max_block_weights,
+                ctx.partition.min_block_weights,
             )
 
             refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
@@ -76,7 +77,8 @@ class KWayMultilevelPartitioner:
                 fine_part = coarsener.uncoarsen(p_graph.partition)
                 fine_graph = coarsener.current_graph
                 p_graph = PartitionedGraph.create(
-                    fine_graph, k, fine_part, ctx.partition.max_block_weights
+                    fine_graph, k, fine_part, ctx.partition.max_block_weights,
+                    ctx.partition.min_block_weights,
                 )
                 refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
                 p_graph = refiner.refine(p_graph)

@@ -30,6 +30,8 @@ class RBMultilevelPartitioner:
         sub_ctx.mode = PartitioningMode.KWAY
         sub_ctx.partition.k = 2
         sub_ctx.partition.max_block_weights = max_bw
+        # Final-k minimums do not apply to intermediate bisections.
+        sub_ctx.partition.min_block_weights = None
         p = KWayMultilevelPartitioner(sub_ctx, graph).partition()
         return np.asarray(p.partition)
 
@@ -65,6 +67,14 @@ class RBMultilevelPartitioner:
                 ctx.partition.k,
                 np.asarray(ctx.partition.max_block_weights, dtype=np.int64),
             )
-        return PartitionedGraph.create(
-            self.graph, ctx.partition.k, part, ctx.partition.max_block_weights
+        p_graph = PartitionedGraph.create(
+            self.graph, ctx.partition.k, part, ctx.partition.max_block_weights,
+            ctx.partition.min_block_weights,
         )
+        # RB's refinement happens inside the bisections where the final-k
+        # minimums cannot apply; enforce them with one k-way balancing pass.
+        if ctx.partition.min_block_weights is not None:
+            from ..refinement.balancer import UnderloadBalancer
+
+            p_graph = UnderloadBalancer(ctx.refinement.balancer).refine(p_graph)
+        return p_graph

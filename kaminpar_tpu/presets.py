@@ -24,9 +24,12 @@ def create_default_context() -> Context:
     greedy balancer + LP refinement, deep scheme."""
     ctx = Context(preset_name="default")
     ctx.mode = PartitioningMode.DEEP
+    # presets.cc:334-336: OVERLOAD_BALANCER, LABEL_PROPAGATION,
+    # UNDERLOAD_BALANCER (the latter is a no-op without min block weights).
     ctx.refinement.algorithms = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
     )
     return ctx
 
@@ -51,6 +54,7 @@ def create_strong_context() -> Context:
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,
         RefinementAlgorithm.JET,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
     )
     return ctx
 
@@ -60,7 +64,10 @@ def create_jet_context() -> Context:
     refiner (plus balancing, which JET invokes internally)."""
     ctx = create_default_context()
     ctx.preset_name = "jet"
-    ctx.refinement.algorithms = (RefinementAlgorithm.JET,)
+    ctx.refinement.algorithms = (
+        RefinementAlgorithm.JET,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+    )
     return ctx
 
 

@@ -60,36 +60,138 @@ def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k
     rel = rel + jitter
 
     # --- source-side admission: cover each block's overload ---------------
-    src = jnp.where(eligible, labels, k)
-    order = jnp.lexsort((-rel, src))
-    s_s = src[order]
-    w_s = jnp.where(eligible[order], node_w[order], 0)
-    first = run_starts(s_s)
-    prefix_excl = segment_prefix_sum(w_s, first) - w_s
-    s_valid = s_s < k
-    s_idx = jnp.where(s_valid, s_s, 0)
     overload = jnp.maximum(block_weights - max_bw, 0)
-    keep_src = s_valid & (prefix_excl < overload[s_idx])
-    src_ok = jnp.zeros(n, dtype=bool).at[order].set(keep_src)
+    src_ok = _admit_by_budget(eligible, labels, rel, node_w, overload, k, inclusive=False)
 
     # --- target-side capacity auction -------------------------------------
     admitted = eligible & src_ok
-    tgt = jnp.where(admitted, target, k)
-    order2 = jnp.lexsort((-rel, tgt))
-    t_s = tgt[order2]
-    w_t = jnp.where(admitted[order2], node_w[order2], 0)
-    first2 = run_starts(t_s)
-    prefix2 = segment_prefix_sum(w_t, first2)
-    t_valid = t_s < k
-    t_idx = jnp.where(t_valid, t_s, 0)
-    keep_tgt = t_valid & (block_weights[t_idx] + prefix2 <= max_bw[t_idx])
-    tgt_ok = jnp.zeros(n, dtype=bool).at[order2].set(keep_tgt)
+    tgt_ok = _admit_by_budget(
+        admitted, target, rel, node_w, jnp.maximum(max_bw - block_weights, 0), k,
+        inclusive=True,
+    )
 
     commit = admitted & tgt_ok
     new_labels = jnp.where(commit, target, labels)
     new_bw = jax.ops.segment_sum(node_w, new_labels, num_segments=k)
     still_overloaded = jnp.any(new_bw > max_bw)
     return new_labels, jnp.sum(commit).astype(jnp.int32), still_overloaded
+
+
+def _admit_by_budget(mask, block_of, rel, node_w, budget, k: int, *, inclusive: bool):
+    """Per-block greedy admission: sort candidates of each block by
+    decreasing relative gain and keep the prefix whose cumulative weight
+    fits the block's budget (exclusive: admit while already-admitted weight
+    is still below the budget; inclusive: admit only if the move itself
+    still fits).  Shared by both balancers."""
+    n = mask.shape[0]
+    blk = jnp.where(mask, block_of, k)
+    order = jnp.lexsort((-rel, blk))
+    b_s = blk[order]
+    w_s = jnp.where(mask[order], node_w[order], 0)
+    first = run_starts(b_s)
+    prefix = segment_prefix_sum(w_s, first)
+    valid = b_s < k
+    b_idx = jnp.where(valid, b_s, 0)
+    if inclusive:
+        keep = valid & (prefix <= budget[b_idx])
+    else:
+        keep = valid & (prefix - w_s < budget[b_idx])
+    return jnp.zeros(n, dtype=bool).at[order].set(keep)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _underload_round(
+    key, labels, buckets, heavy, gather_idx, node_w, max_bw, min_bw, *, k: int
+):
+    """One bulk-synchronous pull round: underloaded blocks admit the best
+    relative-gain donor nodes until their minimum weight is covered."""
+    n = labels.shape[0]
+    kb, ks = jax.random.split(key)
+    block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
+    underloaded = block_weights < min_bw
+
+    # Restrict targets to underloaded blocks by collapsing every other
+    # block's capacity to its current weight (no room → never selected).
+    eff_max = jnp.where(underloaded, max_bw, block_weights)
+    target, tconn, oconn, has = bucketed_best_moves(
+        kb, labels, buckets, heavy, gather_idx, node_w, block_weights, eff_max,
+        external_only=True, respect_caps=True,
+    )
+
+    # Donors: nodes whose block is not underloaded and can spare their
+    # weight without dropping below its own minimum.
+    donor_blk = ~underloaded
+    surplus = jnp.maximum(block_weights - min_bw, 0)
+    mover = donor_blk[labels] & (node_w > 0)
+
+    # Fallback for movers with no adjacent underloaded target: spread them
+    # over all deficit blocks (deficit-descending order, round-robin by node
+    # index) so every underloaded block can fill in one round even when
+    # empty blocks have no adjacent nodes.
+    deficit = jnp.maximum(min_bw - block_weights, 0)
+    by_deficit = jnp.argsort(-deficit)
+    num_needy = jnp.maximum(jnp.sum(deficit > 0), 1)
+    slot = jnp.arange(n, dtype=jnp.int32) % num_needy.astype(jnp.int32)
+    fb = by_deficit[slot]
+    fallback_ok = (deficit[fb] > 0) & (block_weights[fb] + node_w <= max_bw[fb])
+    use_fb = mover & ~has & fallback_ok & (labels != fb)
+    target = jnp.where(use_fb, fb, target)
+    tconn = jnp.where(use_fb, 0, tconn)
+    eligible = mover & (has | use_fb)
+
+    gain = tconn - oconn
+    rel = gain.astype(jnp.float32) / jnp.maximum(node_w, 1).astype(jnp.float32)
+    rel = rel + jax.random.uniform(ks, (n,), minval=0.0, maxval=1e-3)
+
+    # --- donor-side admission: never drop a donor below its minimum -------
+    src_ok = _admit_by_budget(eligible, labels, rel, node_w, surplus, k, inclusive=True)
+
+    # --- target-side admission: fill each deficit, respect max capacity ---
+    admitted = eligible & src_ok
+    fill_ok = _admit_by_budget(admitted, target, rel, node_w, deficit, k, inclusive=False)
+    cap_ok = _admit_by_budget(
+        admitted, target, rel, node_w, jnp.maximum(max_bw - block_weights, 0), k,
+        inclusive=True,
+    )
+
+    commit = admitted & fill_ok & cap_ok
+    new_labels = jnp.where(commit, target, labels)
+    new_bw = jax.ops.segment_sum(node_w, new_labels, num_segments=k)
+    still_underloaded = jnp.any(new_bw < min_bw)
+    return new_labels, jnp.sum(commit).astype(jnp.int32), still_underloaded
+
+
+class UnderloadBalancer(Refiner):
+    """Greedy minimum-block-weight balancer.
+
+    Reference: ``kaminpar-shm/refinement/balancer/underload_balancer.cc`` —
+    a MultiQueue of relative-gain moves pulls nodes into blocks below their
+    minimum weight, never dropping a donor below its own minimum.  The TPU
+    version replaces the MultiQueue with the same sort/prefix-sum admission
+    rounds as the overload balancer.  No-op unless minimum block weights are
+    configured (underload_balancer.cc:47-50).
+    """
+
+    def __init__(self, ctx: BalancerContext):
+        self.ctx = ctx
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        if p_graph.min_block_weights is None or p_graph.is_min_feasible():
+            return p_graph
+        pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
+        max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        min_bw = jnp.asarray(p_graph.min_block_weights, dtype=pv.node_w.dtype)
+        labels = pv.pad_node_array(p_graph.partition, 0)
+        with scoped_timer("underload_balancer"):
+            for _ in range(self.ctx.max_num_rounds):
+                labels, num_moved, still = _underload_round(
+                    next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
+                    pv.node_w, max_bw, min_bw, k=p_graph.k,
+                )
+                if not bool(still) or int(num_moved) == 0:
+                    break
+        return p_graph.with_partition(labels[: pv.n])
 
 
 class OverloadBalancer(Refiner):

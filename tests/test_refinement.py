@@ -69,3 +69,93 @@ def test_jet_on_rmat():
     refined = JetRefiner(JetContext(num_iterations=8), BalancerContext()).refine(pg)
     assert refined.edge_cut() < before
     assert refined.is_feasible()
+
+
+def test_underload_balancer_fills_empty_blocks():
+    """Reference: underload_balancer.cc — pull weight into blocks below
+    their minimum, without dropping donors below theirs."""
+    from kaminpar_tpu.refinement.balancer import UnderloadBalancer
+
+    g = generators.grid2d_graph(8, 8)
+    part = np.zeros(64, dtype=np.int32)  # blocks 1..3 empty
+    pg = PartitionedGraph.create(
+        g, 4, part,
+        np.full(4, 64, dtype=np.int64),  # max: no overload constraint
+        np.full(4, 12, dtype=np.int64),  # min: every block needs >= 12
+    )
+    assert not pg.is_min_feasible()
+    balanced = UnderloadBalancer(BalancerContext()).refine(pg)
+    assert balanced.is_min_feasible()
+    assert balanced.is_feasible()
+
+
+def test_underload_balancer_noop_without_min_weights():
+    from kaminpar_tpu.refinement.balancer import UnderloadBalancer
+
+    pg = _grid_pgraph(k=4, noise=0.1)
+    out = UnderloadBalancer(BalancerContext()).refine(pg)
+    assert out is pg
+
+
+def test_underload_balancer_respects_donor_minimums():
+    from kaminpar_tpu.refinement.balancer import UnderloadBalancer
+
+    g = generators.grid2d_graph(8, 8)
+    # block 0 has 40 nodes, block 1 has 24, block 2 empty; min 16 each
+    part = np.zeros(64, dtype=np.int32)
+    part[40:] = 1
+    pg = PartitionedGraph.create(
+        g, 3, part,
+        np.full(3, 64, dtype=np.int64),
+        np.full(3, 16, dtype=np.int64),
+    )
+    balanced = UnderloadBalancer(BalancerContext()).refine(pg)
+    bw = np.asarray(balanced.block_weights())
+    assert (bw >= 16).all(), bw
+
+
+def test_facade_min_epsilon_end_to_end():
+    """CLI/facade path: min_epsilon populates min block weights and the
+    default chain's underload balancer enforces them."""
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    g = generators.rgg2d_graph(1024, seed=3)
+    s = KaMinPar("default")
+    s.set_graph(g)
+    part = s.compute_partition(k=4, epsilon=0.10, min_epsilon=0.10)
+    bw = np.bincount(part, weights=np.asarray(g.node_w), minlength=4)
+    perfect = -(-int(np.asarray(g.node_w).sum()) // 4)
+    assert (bw >= np.ceil(0.9 * perfect)).all(), bw
+
+
+def test_underload_balancer_many_empty_blocks():
+    """Review finding: with many empty (no-adjacent-node) deficit blocks the
+    fallback must spread movers across all of them, not one per round."""
+    from kaminpar_tpu.refinement.balancer import UnderloadBalancer
+
+    g = generators.grid2d_graph(16, 16)  # 256 nodes
+    part = np.zeros(256, dtype=np.int32)  # blocks 1..9 empty
+    pg = PartitionedGraph.create(
+        g, 10, part,
+        np.full(10, 256, dtype=np.int64),
+        np.full(10, 20, dtype=np.int64),
+    )
+    balanced = UnderloadBalancer(BalancerContext()).refine(pg)
+    bw = np.asarray(balanced.block_weights())
+    assert (bw >= 20).all(), bw
+
+
+def test_rb_mode_enforces_min_weights():
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.context import PartitioningMode
+
+    ctx = create_context_by_preset_name("default")
+    ctx.mode = PartitioningMode.RB
+    g = generators.rgg2d_graph(512, seed=5)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    part = s.compute_partition(k=4, epsilon=0.10, min_epsilon=0.15)
+    bw = np.bincount(part, weights=np.asarray(g.node_w), minlength=4)
+    perfect = -(-int(np.asarray(g.node_w).sum()) // 4)
+    assert (bw >= np.ceil(0.85 * perfect)).all(), bw
