@@ -27,7 +27,6 @@ import time
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from kaminpar_tpu.coarsening.max_cluster_weights import compute_max_cluster_weight
 from kaminpar_tpu.utils.platform import force_cpu_devices

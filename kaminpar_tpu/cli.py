@@ -29,15 +29,17 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native balanced k-way graph partitioner "
         "(KaMinPar-equivalent).",
     )
-    p.add_argument("graph", help="input graph (METIS or ParHIP format)")
-    p.add_argument("k", type=int, help="number of blocks")
+    p.add_argument("graph", nargs="?", default=None,
+                   help="input graph (METIS or ParHIP format)")
+    p.add_argument("k", nargs="?", type=int, default=None,
+                   help="number of blocks")
     p.add_argument(
         "-P", "--preset", default="default", choices=get_preset_names(),
         help="configuration preset (speed/quality ladder)",
     )
-    p.add_argument("-e", "--epsilon", type=float, default=0.03,
+    p.add_argument("-e", "--epsilon", type=float, default=None,
                    help="max block-weight imbalance factor (default 0.03)")
-    p.add_argument("--min-epsilon", type=float, default=0.0,
+    p.add_argument("--min-epsilon", type=float, default=None,
                    help="max allowed imbalance for minimum block weights; 0 "
                         "disables minimum weights (default)")
     p.add_argument("-f", "--format", default=None, choices=["metis", "parhip"],
@@ -45,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--output", default=None, help="partition output file")
     p.add_argument("--block-sizes", default=None,
                    help="write per-block weight sums to this file")
-    p.add_argument("-s", "--seed", type=int, default=0)
+    p.add_argument("-s", "--seed", type=int, default=None)
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-E", "--experiment", action="store_true",
@@ -53,11 +55,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-timer-depth", type=int, default=3)
     p.add_argument("--use-64bit", action="store_true",
                    help="64-bit node/edge ids and weights")
+    p.add_argument("-C", "--config", default=None, metavar="FILE",
+                   help="load a TOML config over the chosen preset")
+    p.add_argument("--dump-config", action="store_true",
+                   help="print the effective config as TOML and exit")
     return p
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.dump_config:
+        from .config import dump_toml, load_toml_file
+
+        ctx_dump: Context = create_context_by_preset_name(args.preset)
+        if args.config:
+            ctx_dump = load_toml_file(args.config, ctx_dump)
+        if args.seed is not None:
+            ctx_dump.seed = args.seed
+        if args.use_64bit:
+            ctx_dump.use_64bit_ids = True
+        print(dump_toml(ctx_dump))
+        return 0
+    if args.graph is None or args.k is None:
+        parser.error("graph and k are required (unless --dump-config)")
 
     if args.quiet:
         Logger.level = OutputLevel.QUIET
@@ -66,21 +88,34 @@ def main(argv=None) -> int:
     else:
         Logger.level = OutputLevel.EXPERIMENT if args.experiment else OutputLevel.APPLICATION
 
+    ctx: Context = create_context_by_preset_name(args.preset)
+    if args.config:
+        from .config import load_toml_file
+
+        ctx = load_toml_file(args.config, ctx)
+    # CLI flags override the config file only when explicitly passed.
+    if args.seed is not None:
+        ctx.seed = args.seed
+    if args.use_64bit:
+        ctx.use_64bit_ids = True
+
     t0 = time.perf_counter()
-    graph = kio.read_graph(args.graph, args.format, use_64bit=args.use_64bit)
+    graph = kio.read_graph(args.graph, args.format, use_64bit=ctx.use_64bit_ids)
     Logger.log(
         f"Input graph: n={graph.n} m={graph.m // 2} "
         f"(read in {time.perf_counter() - t0:.2f}s)"
     )
 
-    ctx: Context = create_context_by_preset_name(args.preset)
-    ctx.seed = args.seed
-    ctx.use_64bit_ids = args.use_64bit
-
     solver = KaMinPar(ctx)
     solver.set_graph(graph)
     part = solver.compute_partition(
-        k=args.k, epsilon=args.epsilon, min_epsilon=args.min_epsilon
+        k=args.k,
+        epsilon=args.epsilon if args.epsilon is not None else ctx.partition.epsilon,
+        min_epsilon=(
+            args.min_epsilon
+            if args.min_epsilon is not None
+            else ctx.partition.min_epsilon
+        ),
     )
 
     p_graph = solver.last_partition

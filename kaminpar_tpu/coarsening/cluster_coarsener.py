@@ -55,6 +55,18 @@ class ClusterCoarsener:
         max_cw = compute_max_cluster_weight(
             self.ctx.coarsening, graph.n, graph.total_node_weight, k, epsilon
         )
+        # Bound the per-level shrink: synchronous LP on dense graphs piles
+        # nodes into popular clusters up to the global cap within one level
+        # (measured 8x/level on rgg), collapsing the hierarchy to 2 levels
+        # and back-loading the entire k-extension onto the finest graph.
+        # Capping cluster weight at ~shrink_factor x the average node weight
+        # restores the gradual hierarchy the multilevel scheme needs.  (The
+        # reference's asynchronous LP grows clusters one sweep at a time,
+        # which bounds the per-level shrink implicitly.)
+        sf = self.ctx.coarsening.max_shrink_factor
+        if sf > 0:
+            avg_w = graph.total_node_weight / max(graph.n, 1)
+            max_cw = min(max_cw, max(int(sf * avg_w), 1))
         with scoped_timer("coarsening"):
             labels = self.clusterer.compute_clustering(graph, max_cw)
             coarse, coarse_of = contract_clustering(graph, labels)

@@ -55,6 +55,7 @@ class LPClustering:
                 jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
                 num_labels=n_pad,
                 max_iterations=self.ctx.num_iterations,
+                active_prob=self.ctx.active_prob,
             )
 
             if self.ctx.cluster_isolated_nodes:

@@ -38,6 +38,7 @@ class RefinementAlgorithm(enum.Enum):
     NOOP = "noop"
     LP = "lp"
     JET = "jet"
+    KWAY_FM = "kway-fm"
     OVERLOAD_BALANCER = "overload-balancer"
     UNDERLOAD_BALANCER = "underload-balancer"
     GREEDY_BALANCER = "greedy-balancer"  # alias used by some presets
@@ -84,6 +85,14 @@ class LabelPropagationContext:
     # Match otherwise-unmergeable singleton clusters through their favored
     # cluster (reference two-hop clustering, label_propagation.h:919-1120).
     cluster_two_hop_nodes: bool = True
+    # Fraction of nodes allowed to move per synchronous round — the
+    # bulk-synchronous analog of the reference's chunked rounds; < 1 breaks
+    # Jacobi-LP swap cycles (see ops/lp.py:_commit_moves).
+    active_prob: float = 1.0
+    # Accept zero-gain moves with probability 1/2 (the reference LP
+    # refiner's tie behavior, lp_refiner.cc:258-260); requires
+    # active_prob < 1 to stay oscillation-safe under synchronous commits.
+    allow_tie_moves: bool = False
 
 
 @dataclass
@@ -91,10 +100,16 @@ class CoarseningContext:
     """Reference: ``CoarseningContext`` (kaminpar.h) + max_cluster_weights.h."""
 
     algorithm: ClusteringAlgorithm = ClusteringAlgorithm.LP
-    lp: LabelPropagationContext = field(default_factory=LabelPropagationContext)
+    lp: LabelPropagationContext = field(
+        default_factory=lambda: LabelPropagationContext(active_prob=0.5)
+    )
     # Coarsen until n <= contraction_limit * k (kway) or 2*contraction_limit
     # (deep); reference default C = 2000 (deep_multilevel.cc:170-183).
     contraction_limit: int = 2000
+    # Bound per-level shrink: cluster weight additionally capped at
+    # max_shrink_factor * average node weight (0 disables).  See
+    # cluster_coarsener.coarsen_once for why synchronous LP needs this.
+    max_shrink_factor: float = 3.5
     # Stop coarsening when a level shrinks by less than this factor
     # (reference: convergence_threshold).
     convergence_threshold: float = 0.05
@@ -108,6 +123,9 @@ class InitialPartitioningContext:
     bipartitioners + 2-way FM (initial_pool_bipartitioner.cc:24)."""
 
     mode: InitialPartitioningMode = InitialPartitioningMode.SEQUENTIAL
+    # Spend the imbalance budget evenly across bisection levels (reference:
+    # use_adaptive_epsilon / create_twoway_context, helper.cc:103-130).
+    use_adaptive_epsilon: bool = True
     # Number of repetitions of each enabled flat bipartitioner.
     min_num_repetitions: int = 4
     max_num_repetitions: int = 12
@@ -137,6 +155,9 @@ class InitialPartitioningContext:
 class JetContext:
     """Reference: ``JetRefinementContext`` (refinement/jet/jet_refiner.cc)."""
 
+    # Number of full JET invocations chained per refinement step (reference:
+    # create_jet_context(num_rounds), presets.cc "jet"/"4xjet").
+    num_rounds: int = 1
     num_iterations: int = 12
     num_fruitless_iterations: int = 12
     fruitless_threshold: float = 0.999
@@ -154,6 +175,21 @@ class BalancerContext:
 
 
 @dataclass
+class FMContext:
+    """k-way FM refiner parameters (reference: ``KwayFMRefinementContext``,
+    presets.cc:348-365)."""
+
+    num_iterations: int = 10
+    alpha: float = 1.0  # adaptive stopping (Osipov/Sanders)
+    num_fruitless_moves: int = 100
+    abortion_threshold: float = 0.999
+    # TPU divergence: FM runs as a sequential host pass on small levels only;
+    # JET is the at-scale device refiner (see fm_refiner.py module docstring).
+    # Cost scales with border size, not n (measured ~1s at n=65k, k=64).
+    max_n: int = 1 << 17
+
+
+@dataclass
 class RefinementContext:
     """Pipeline of refiners, run in order on every uncoarsening level
     (reference: MultiRefiner, factories.cc:97-147)."""
@@ -162,11 +198,15 @@ class RefinementContext:
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,
     )
+    # Strict-improvement LP (measured: bulk-synchronous zero-gain "tie"
+    # moves *hurt* — simultaneous tie movers interact; async diffusion has
+    # no safe sync analog here.  JET plays that role instead.)
     lp: LabelPropagationContext = field(
         default_factory=lambda: LabelPropagationContext(num_iterations=5)
     )
     jet: JetContext = field(default_factory=JetContext)
     balancer: BalancerContext = field(default_factory=BalancerContext)
+    fm: FMContext = field(default_factory=FMContext)
 
 
 @dataclass
@@ -201,10 +241,12 @@ class PartitionContext:
         # node weight; the facade adjusts for node weights (kaminpar.cc).
         self.max_block_weights = np.full(k, max(max_bw, perfect + 1), dtype=np.int64)
         if min_epsilon > 0.0:
-            # min_bw = ceil((1 - min_eps) * perfect) (context.cc:72-81)
-            self.min_block_weights = np.full(
-                k, int(math.ceil((1.0 - min_epsilon) * perfect)), dtype=np.int64
-            )
+            # min_bw = ceil((1 - min_eps) * perfect) (context.cc:72-81),
+            # clamped so k * min_bw <= W stays satisfiable (perfect is
+            # already rounded up, so the raw formula can over-demand).
+            min_bw = int(math.ceil((1.0 - min_epsilon) * perfect))
+            min_bw = min(min_bw, total_node_weight // k)
+            self.min_block_weights = np.full(k, min_bw, dtype=np.int64)
         else:
             self.min_block_weights = None
 

@@ -29,6 +29,10 @@ def create_refiner(ctx: Context, *, coarse_level: bool = False) -> Refiner:
             refiners.append(OverloadBalancer(ctx.refinement.balancer))
         elif algo == RefinementAlgorithm.UNDERLOAD_BALANCER:
             refiners.append(UnderloadBalancer(ctx.refinement.balancer))
+        elif algo == RefinementAlgorithm.KWAY_FM:
+            from .refinement.fm_refiner import FMRefiner
+
+            refiners.append(FMRefiner(ctx.refinement.fm))
         elif algo == RefinementAlgorithm.JET:
             refiners.append(
                 JetRefiner(ctx.refinement.jet, ctx.refinement.balancer, coarse_level=coarse_level)

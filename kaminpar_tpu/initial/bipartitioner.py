@@ -48,6 +48,15 @@ def _cut(g: HostCSR, part: np.ndarray) -> int:
     return int(g.edge_w[part[u] != part[g.col_idx]].sum()) // 2
 
 
+def _move_gains(g: HostCSR, part: np.ndarray) -> np.ndarray:
+    """Per-node 2-way move gain: external minus internal connection."""
+    gain = np.zeros(g.n, dtype=np.int64)
+    u_arr = np.repeat(np.arange(g.n), np.diff(g.row_ptr))
+    same = part[u_arr] == part[g.col_idx]
+    np.add.at(gain, u_arr, np.where(same, -g.edge_w, g.edge_w))
+    return gain
+
+
 def _block_weights(g: HostCSR, part: np.ndarray) -> np.ndarray:
     return np.bincount(part, weights=g.node_w, minlength=2).astype(np.int64)
 
@@ -156,11 +165,7 @@ def _fm_refine_2way(
     bw = _block_weights(g, part)
 
     for _ in range(num_iterations):
-        # gains: external - internal connection weight
-        gain = np.zeros(n, dtype=np.int64)
-        u_arr = np.repeat(np.arange(n), np.diff(g.row_ptr))
-        same = part[u_arr] == part[g.col_idx]
-        np.add.at(gain, u_arr, np.where(same, -g.edge_w, g.edge_w))
+        gain = _move_gains(g, part)
 
         locked = np.zeros(n, dtype=bool)
         heap = [(-int(gain[u]), int(rng.integers(1 << 30)), int(u)) for u in range(n)]
@@ -376,6 +381,33 @@ def multilevel_bipartition(
     return part
 
 
+def _rebalance_2way(g: HostCSR, part: np.ndarray, max_w: np.ndarray, rng) -> np.ndarray:
+    """Forced balance repair: move least-loss border nodes out of the
+    overweight side until both sides fit (the role of the reference initial
+    FM's hard balance constraint — our FM only accepts budget-respecting
+    moves, so an infeasible start could never become feasible without
+    this)."""
+    part = part.copy()
+    bw = _block_weights(g, part)
+    for side in (0, 1):
+        if bw[side] <= max_w[side]:
+            continue
+        other = 1 - side
+        gain = _move_gains(g, part)  # move least-loss (max gain) first
+        cand = np.flatnonzero(part == side)
+        order = cand[np.argsort(-(gain[cand] + rng.random(len(cand))))]
+        for u in order:
+            if bw[side] <= max_w[side]:
+                break
+            w_u = int(g.node_w[u])
+            if bw[other] + w_u > max_w[other]:
+                continue
+            part[u] = other
+            bw[side] -= w_u
+            bw[other] += w_u
+    return part
+
+
 def pool_bipartition(
     g: HostCSR,
     max_w: np.ndarray,
@@ -383,9 +415,10 @@ def pool_bipartition(
     ctx: Optional[InitialPartitioningContext] = None,
 ) -> np.ndarray:
     """Run the enabled bipartitioners with repetitions + FM, keep the best
-    (feasibility first, then cut).  Reference: InitialPoolBipartitioner
-    (initial_pool_bipartitioner.cc:24) with adaptive selection simplified to
-    fixed repetitions."""
+    (feasibility first, then cut); if nothing feasible survives, repair the
+    best candidate with a forced balance pass.  Reference:
+    InitialPoolBipartitioner (initial_pool_bipartitioner.cc:24) with
+    adaptive selection simplified to fixed repetitions."""
     ctx = ctx or InitialPartitioningContext()
     enabled = []
     if ctx.enable_bfs_bipartitioner:
@@ -410,6 +443,10 @@ def pool_bipartition(
             if best is None or cand > (best[0], -best[1]):
                 best = (feasible, cut, part)
     assert best is not None, "no bipartitioner enabled"
+    if not best[0]:  # nothing feasible: force balance, then re-refine
+        part = _rebalance_2way(g, best[2], max_w, rng)
+        part = _fm_refine_2way(g, part, max_w, rng, ctx.fm_num_iterations, ctx.fm_alpha)
+        return part
     return best[2]
 
 
@@ -435,6 +472,39 @@ def extract_subgraph(
     return sub, nodes
 
 
+def _twoway_budgets(
+    g: HostCSR, k: int, max_block_weights: np.ndarray, k0: int, adaptive: bool
+) -> np.ndarray:
+    """Budgets for one bisection of a k-way recursive split.
+
+    Reference: ``create_twoway_context`` (partitioning/helper.cc:63-140) —
+    plain sums of the final per-block budgets leave deeper bisections with
+    zero slack (a block at its summed cap must then split *perfectly*), so
+    the reference adapts epsilon KaHyPar-style: spend the total imbalance
+    budget evenly across the ceil_log2(k) bisection levels.
+    """
+    s0 = int(max_block_weights[:k0].sum())
+    s1 = int(max_block_weights[k0:k].sum())
+    if not adaptive or k <= 2:
+        return np.array([s0, s1], dtype=np.int64)
+    W = g.total_node_weight
+    if W <= 0:
+        return np.array([s0, s1], dtype=np.int64)
+    base = (s0 + s1) / W
+    exponent = 1.0 / max((k - 1).bit_length(), 1)  # 1/ceil_log2(k)
+    adapted_eps = max(base**exponent - 1.0, 1e-4)
+    total = s0 + s1
+    mw = np.array(
+        [
+            int((1.0 + adapted_eps) * W * s0 / total),
+            int((1.0 + adapted_eps) * W * s1 / total),
+        ],
+        dtype=np.int64,
+    )
+    # Never exceed the non-adaptive budgets (the hard constraint).
+    return np.minimum(mw, np.array([s0, s1], dtype=np.int64))
+
+
 def recursive_bipartition(
     g: HostCSR,
     k: int,
@@ -446,17 +516,16 @@ def recursive_bipartition(
 
     Reference: ``extend_partition_recursive`` (partitioning/helper.cc:143) /
     the RB scheme: split k into k0=ceil(k/2), k1=k-k0; the bisection's block
-    budgets are the sums of the final per-block budgets (so imbalance does not
-    accumulate through the recursion).
+    budgets are adaptive-epsilon shares of the final per-block budget sums
+    (see :func:`_twoway_budgets`).
     """
     part = np.zeros(g.n, dtype=np.int32)
     if k <= 1 or g.n == 0:
         return part
     k0 = (k + 1) // 2
     k1 = k - k0
-    mw = np.array(
-        [max_block_weights[:k0].sum(), max_block_weights[k0:k].sum()], dtype=np.int64
-    )
+    ctx_ = ctx or InitialPartitioningContext()
+    mw = _twoway_budgets(g, k, max_block_weights, k0, ctx_.use_adaptive_epsilon)
     bi = multilevel_bipartition(g, mw, rng, ctx, final_k=k)
     for side, (kk, offset) in enumerate(((k0, 0), (k1, k0))):
         sub, nodes = extract_subgraph(g, bi, side)

@@ -77,7 +77,7 @@ def capacity_auction(key, movers, target, node_w, base_weights, max_weights, num
     return jnp.zeros(n, dtype=bool).at[order].set(ok)
 
 
-@partial(jax.jit, static_argnames=("num_labels",))
+@partial(jax.jit, static_argnames=("num_labels", "active_prob", "allow_tie_moves"))
 def lp_round(
     state: LPState,
     key,
@@ -88,6 +88,8 @@ def lp_round(
     max_label_weights,  # (num_labels,)
     *,
     num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
 ) -> LPState:
     """One synchronous LP round; returns the updated state.
 
@@ -95,18 +97,59 @@ def lp_round(
     (label_propagation.h:1682) over all nodes.
     """
     kr, kp = jax.random.split(key)
-    target, tconn, _, _ = best_moves(
+    target, tconn, own_conn, _ = best_moves(
         kr, state.labels, edge_u, col_idx, edge_w, node_w, state.label_weights,
         max_label_weights, num_labels=num_labels,
         external_only=False, respect_caps=True,
     )
-    return _commit_moves(state, kp, target, tconn, node_w, max_label_weights, num_labels)
+    return _commit_moves(
+        state, kp, target, tconn, own_conn, node_w, max_label_weights, num_labels,
+        active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+    )
 
 
-def _commit_moves(state: LPState, kp, target, tconn, node_w, max_label_weights, num_labels: int):
+def _commit_moves(
+    state: LPState,
+    kp,
+    target,
+    tconn,
+    own_conn,
+    node_w,
+    max_label_weights,
+    num_labels: int,
+    *,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
+):
+    """Synchronous (Jacobi) LP needs two oscillation guards the reference's
+    asynchronous sweep gets for free (label_propagation.h processes nodes
+    in-place, so each node sees its predecessors' moves):
+
+    - *tie stickiness*: move only on a strict rating improvement over the
+      current cluster — otherwise equal-rated nodes flip between clusters
+      forever on symmetric graphs (grids), and
+    - *random active subset* (``active_prob`` < 1): the bulk-synchronous
+      analog of the reference's chunked dist rounds
+      (global_lp_clusterer.cc); breaks two-cycles where adjacent nodes
+      adopt each other's labels (both strict improvements) and swap back
+      and forth without ever merging.
+
+    ``allow_tie_moves`` restores the reference LP *refiner's* zero-gain
+    diffusion (lp_refiner.cc:258-260 accepts equal-gain clusters with a
+    random bool) — a tie move happens with probability 1/2, and must be
+    combined with ``active_prob`` < 1 to stay oscillation-safe under
+    synchronous commits.  Clustering keeps strict stickiness.
+    """
     labels, label_weights, _ = state
-    desired = jnp.where(tconn > 0, target, labels)
+    kp, ka, kt = jax.random.split(kp, 3)
+    better = tconn > own_conn
+    if allow_tie_moves:
+        coin = jax.random.bernoulli(kt, 0.5, tconn.shape)
+        better = better | ((tconn == own_conn) & coin)
+    desired = jnp.where(better, target, labels)
     moved = desired != labels
+    if active_prob < 1.0:
+        moved = moved & jax.random.bernoulli(ka, active_prob, moved.shape)
     accept = capacity_auction(
         kp, moved, desired, node_w, label_weights, max_label_weights, num_labels
     )
@@ -116,7 +159,7 @@ def _commit_moves(state: LPState, kp, target, tconn, node_w, max_label_weights, 
     return LPState(new_labels, new_weights, jnp.sum(commit).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("num_labels",))
+@partial(jax.jit, static_argnames=("num_labels", "active_prob", "allow_tie_moves"))
 def lp_round_bucketed(
     state: LPState,
     key,
@@ -127,18 +170,23 @@ def lp_round_bucketed(
     max_label_weights,
     *,
     num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
 ) -> LPState:
     """lp_round over the degree-bucketed layout (the fast path)."""
     kr, kp = jax.random.split(key)
-    target, tconn, _, _ = bucketed_best_moves(
+    target, tconn, own_conn, _ = bucketed_best_moves(
         kr, state.labels, buckets, heavy, gather_idx, node_w,
         state.label_weights, max_label_weights,
         external_only=False, respect_caps=True,
     )
-    return _commit_moves(state, kp, target, tconn, node_w, max_label_weights, num_labels)
+    return _commit_moves(
+        state, kp, target, tconn, own_conn, node_w, max_label_weights, num_labels,
+        active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+    )
 
 
-@partial(jax.jit, static_argnames=("num_labels", "max_iterations"))
+@partial(jax.jit, static_argnames=("num_labels", "max_iterations", "active_prob", "allow_tie_moves"))
 def lp_iterate_bucketed(
     state: LPState,
     key,
@@ -151,6 +199,8 @@ def lp_iterate_bucketed(
     *,
     num_labels: int,
     max_iterations: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
 ) -> LPState:
     """Up to ``max_iterations`` LP rounds fused into one on-device while loop
     with the early-exit condition (< min_moved nodes moved) evaluated on
@@ -166,6 +216,7 @@ def lp_iterate_bucketed(
         st = lp_round_bucketed(
             st, jax.random.fold_in(key, i), buckets, heavy, gather_idx,
             node_w, max_label_weights, num_labels=num_labels,
+            active_prob=active_prob, allow_tie_moves=allow_tie_moves,
         )
         return i + 1, st
 

@@ -71,6 +71,16 @@ class DeepMultilevelPartitioner:
         max_bw = intermediate_block_weights(
             np.asarray(self.ctx.partition.max_block_weights, dtype=np.int64), cur_k
         )
+        if coarse:
+            # Relax caps on coarse graphs by their (chunky) max node weight
+            # (reference: PartitionContext::setup relax_max_block_weights,
+            # context.cc:61-68) — refinement moves need headroom when a
+            # single coarse node weighs a significant budget fraction.
+            eps = self.ctx.partition.epsilon
+            relaxed = np.ceil(max_bw / (1.0 + eps)).astype(np.int64) + int(
+                graph.max_node_weight
+            )
+            max_bw = np.maximum(max_bw, relaxed)
         # Minimum block weights apply once the partition carries the final k
         # (intermediate blocks merge several final blocks; their minimums
         # would over-constrain refinement).
@@ -109,6 +119,8 @@ class DeepMultilevelPartitioner:
                 )
             p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
 
+            debug = Logger.level.value >= OutputLevel.DEBUG.value
+
             while True:
                 graph = coarsener.current_graph
                 target_k = compute_k_for_n(graph.n, C, k) if coarsener.num_levels > 0 else k
@@ -116,13 +128,40 @@ class DeepMultilevelPartitioner:
                     part = extend_partition(
                         graph, np.asarray(p_graph.partition), cur_k, target_k, ctx
                     )
+                    if debug:
+                        from ..graph import metrics as _m
+
+                        mb = intermediate_block_weights(
+                            np.asarray(self.ctx.partition.max_block_weights), target_k
+                        )
+                        pre = PartitionedGraph.create(graph, target_k, part, mb)
+                        pre_cut = pre.edge_cut()
+                        pre_over = _m.total_overload(graph, part, target_k, mb)
                     cur_k = target_k
                     p_graph = self._refine(graph, part, cur_k, coarsener.num_levels > 0)
+                    if debug:
+                        Logger.log(
+                            f"  deep: n={graph.n} extended k->{cur_k}: cut "
+                            f"{pre_cut} (overload {pre_over}) -> refined "
+                            f"{p_graph.edge_cut()}",
+                            OutputLevel.DEBUG,
+                        )
                 if coarsener.num_levels == 0:
                     break
                 fine_part = coarsener.uncoarsen(p_graph.partition)
+                if debug:
+                    pre = PartitionedGraph.create(
+                        coarsener.current_graph, cur_k, fine_part,
+                        self.ctx.partition.max_block_weights[:1],
+                    ).edge_cut()
                 p_graph = self._refine(
                     coarsener.current_graph, fine_part, cur_k, coarsener.num_levels > 0
                 )
+                if debug:
+                    Logger.log(
+                        f"  deep: n={coarsener.current_graph.n} k={cur_k} projected: "
+                        f"cut {pre} -> refined {p_graph.edge_cut()}",
+                        OutputLevel.DEBUG,
+                    )
 
         return p_graph

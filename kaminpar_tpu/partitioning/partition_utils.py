@@ -24,9 +24,15 @@ import numpy as np
 
 
 def compute_k_for_n(n: int, contraction_limit: int, k: int) -> int:
-    if n <= 2 * contraction_limit:
+    """Blocks a graph with n nodes should carry (reference:
+    partition_utils.cc:92-100 — note *ceil*_log2: extension is front-loaded
+    onto coarse levels, where bisections are cheap and every subsequent
+    level refines at the higher k; floor would back-load a huge extension
+    jump onto the finest level where refinement can no longer recover)."""
+    if n < 2 * contraction_limit:
         return 2
-    kk = 1 << int(math.floor(math.log2(max(n / contraction_limit, 2.0))))
+    ratio = -(n // -contraction_limit)  # ceil(n / C)
+    kk = 1 << max(ratio - 1, 1).bit_length()  # 2^ceil_log2(ratio)
     return int(min(max(kk, 2), k))
 
 

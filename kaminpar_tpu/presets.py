@@ -34,10 +34,8 @@ def create_default_context() -> Context:
     return ctx
 
 
-def create_fast_context() -> Context:
-    """Reference: ``create_fast_context``: fewer LP iterations, fast IP."""
-    ctx = create_default_context()
-    ctx.preset_name = "fast"
+def _apply_fast_delta(ctx: Context) -> Context:
+    """The fast preset's reduced iteration budgets."""
     ctx.coarsening.lp.num_iterations = 1
     ctx.refinement.lp.num_iterations = 2
     ctx.initial_partitioning.min_num_repetitions = 1
@@ -45,29 +43,64 @@ def create_fast_context() -> Context:
     return ctx
 
 
-def create_strong_context() -> Context:
-    """Reference eco/strong presets add FM; our TPU-native quality refiner is
-    JET (SURVEY §7 stage 7) layered on top of balancer + LP."""
+def _apply_largek_delta(ctx: Context) -> Context:
+    """The largek presets' tuning: bigger contraction limit for k > 1024."""
+    ctx.coarsening.contraction_limit = 640
+    return ctx
+
+
+def create_fast_context() -> Context:
+    """Reference: ``create_fast_context``: fewer LP iterations, fast IP."""
+    ctx = _apply_fast_delta(create_default_context())
+    ctx.preset_name = "fast"
+    return ctx
+
+
+def create_eco_context() -> Context:
+    """Reference: ``create_*_eco_context`` (presets.cc:466-469): overload
+    balancer, LP, k-way FM, overload balancer."""
     ctx = create_default_context()
-    ctx.preset_name = "strong"
+    ctx.preset_name = "eco"
     ctx.refinement.algorithms = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,
-        RefinementAlgorithm.JET,
+        RefinementAlgorithm.KWAY_FM,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.UNDERLOAD_BALANCER,
     )
     return ctx
 
 
-def create_jet_context() -> Context:
-    """Reference: ``create_jet_context`` (presets.cc): JET as the only
-    refiner (plus balancing, which JET invokes internally)."""
+def create_strong_context() -> Context:
+    """Reference: ``create_*_strong_context`` (presets.cc:479-484): the eco
+    chain plus two-way flow refinement.  Flow is replaced by JET (documented
+    divergence: max-flow's augmenting-path structure has no efficient XLA
+    mapping; JET is the TPU-native quality refiner, SURVEY §7 stage 7)."""
+    ctx = create_eco_context()
+    ctx.preset_name = "strong"
+    ctx.refinement.algorithms = (
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.LP,
+        RefinementAlgorithm.KWAY_FM,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.JET,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+    )
+    return ctx
+
+
+def create_jet_context(num_rounds: int = 1) -> Context:
+    """Reference: ``create_jet_context(num_rounds)`` (presets.cc
+    "jet"/"4xjet"): JET as the only refiner (plus balancing, which JET
+    invokes internally)."""
     ctx = create_default_context()
-    ctx.preset_name = "jet"
+    ctx.preset_name = "jet" if num_rounds == 1 else f"{num_rounds}xjet"
     ctx.refinement.algorithms = (
         RefinementAlgorithm.JET,
         RefinementAlgorithm.UNDERLOAD_BALANCER,
     )
+    ctx.refinement.jet.num_rounds = num_rounds
     return ctx
 
 
@@ -80,11 +113,44 @@ def create_noref_context() -> Context:
 
 
 def create_largek_context() -> Context:
-    """Reference: ``create_largek_context``: tuned for k > 1024 — smaller
-    contraction limit per block."""
-    ctx = create_default_context()
+    """Reference: ``create_largek_context``: tuned for k > 1024."""
+    ctx = _apply_largek_delta(create_default_context())
     ctx.preset_name = "largek"
-    ctx.coarsening.contraction_limit = 640
+    return ctx
+
+
+def create_largek_fast_context() -> Context:
+    """Reference: ``create_largek_fast_context``: largek + fast deltas."""
+    ctx = _apply_fast_delta(create_largek_context())
+    ctx.preset_name = "largek-fast"
+    return ctx
+
+
+def create_largek_eco_context() -> Context:
+    """Reference: ``create_largek_eco_context``: largek + the eco chain."""
+    ctx = _apply_largek_delta(create_eco_context())
+    ctx.preset_name = "largek-eco"
+    return ctx
+
+
+def create_largek_strong_context() -> Context:
+    """Reference: ``create_largek_strong_context``: largek + the strong
+    chain."""
+    ctx = _apply_largek_delta(create_strong_context())
+    ctx.preset_name = "largek-strong"
+    return ctx
+
+
+def create_linear_time_kway_context() -> Context:
+    """Reference: ``create_linear_time_kway_context`` — single-shot k-way
+    with LP-only refinement for linear total work."""
+    ctx = create_kway_context()
+    ctx.preset_name = "linear-time-kway"
+    ctx.coarsening.lp.num_iterations = 2
+    ctx.refinement.algorithms = (
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.LP,
+    )
     return ctx
 
 
@@ -101,11 +167,25 @@ _PRESETS = {
     "default": create_default_context,
     "fast": create_fast_context,
     "strong": create_strong_context,
-    "eco": create_strong_context,  # until flow/FM-class refiners land
+    "flow": create_strong_context,  # reference alias (presets.cc:26)
+    "eco": create_eco_context,
+    "fm": create_eco_context,  # reference alias (presets.cc:24)
     "jet": create_jet_context,
+    "4xjet": lambda: create_jet_context(4),
     "noref": create_noref_context,
     "largek": create_largek_context,
+    "largek-fast": create_largek_fast_context,
+    "largek-eco": create_largek_eco_context,
+    "largek-strong": create_largek_strong_context,
+    # esa21-* (the original ESA'21 deep multilevel configurations) map onto
+    # the deep-scheme presets above — rename-only aliases like "fm"/"flow".
+    "esa21-smallk": create_default_context,
+    "esa21-largek": create_largek_context,
+    "esa21-largek-fast": create_largek_fast_context,
+    "esa21-strong": create_strong_context,
     "kway": create_kway_context,
+    "mtkahypar-kway": create_kway_context,
+    "linear-time-kway": create_linear_time_kway_context,
 }
 
 

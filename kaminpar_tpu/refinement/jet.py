@@ -72,6 +72,12 @@ class JetRefiner(Refiner):
         self.coarse_level = coarse_level
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        # "4xjet"-style chaining (reference: create_jet_context(num_rounds)).
+        for _ in range(max(self.ctx.num_rounds, 1)):
+            p_graph = self._refine_once(p_graph)
+        return p_graph
+
+    def _refine_once(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         pv = p_graph.graph.padded()
         bv = p_graph.graph.bucketed()
         k = p_graph.k

@@ -43,5 +43,7 @@ class LPRefiner(Refiner):
                 jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
                 num_labels=k,
                 max_iterations=self.ctx.num_iterations,
+                active_prob=self.ctx.active_prob,
+                allow_tie_moves=self.ctx.allow_tie_moves,
             )
         return p_graph.with_partition(state.labels[: pv.n])

@@ -22,8 +22,18 @@ class MultiRefiner(Refiner):
         self.refiners = list(refiners)
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        from ..utils.logger import Logger, OutputLevel
+
+        debug = Logger.level.value >= OutputLevel.DEBUG.value
         for r in self.refiners:
+            if debug:
+                before = p_graph.edge_cut()
             p_graph = r.refine(p_graph)
+            if debug:
+                Logger.log(
+                    f"    {type(r).__name__}: cut {before} -> {p_graph.edge_cut()}",
+                    OutputLevel.DEBUG,
+                )
         return p_graph
 
 

@@ -1,0 +1,53 @@
+"""TOML config round-trip tests (reference: the CLI11 --dump-config/-C
+machinery used by apps/KaMinPar.cc)."""
+
+import subprocess
+import sys
+
+from kaminpar_tpu.config import dump_toml, load_toml
+from kaminpar_tpu.context import RefinementAlgorithm
+from kaminpar_tpu.presets import create_context_by_preset_name, get_preset_names
+
+
+def test_dump_load_roundtrip_all_presets():
+    for name in get_preset_names():
+        ctx = create_context_by_preset_name(name)
+        text = dump_toml(ctx)
+        ctx2 = load_toml(text)
+        assert ctx2.to_dict() == ctx.to_dict(), name
+
+
+def test_load_overrides():
+    ctx = load_toml(
+        """
+preset_name = "fast"
+seed = 7
+
+[coarsening.lp]
+num_iterations = 3
+
+[refinement]
+algorithms = ["jet"]
+"""
+    )
+    assert ctx.preset_name == "fast"
+    assert ctx.seed == 7
+    assert ctx.coarsening.lp.num_iterations == 3
+    assert ctx.refinement.algorithms == (RefinementAlgorithm.JET,)
+
+
+def test_load_rejects_unknown_key():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown config key"):
+        load_toml("[coarsening]\nnot_a_field = 1\n")
+
+
+def test_cli_dump_config():
+    out = subprocess.run(
+        [sys.executable, "-m", "kaminpar_tpu", "-P", "eco", "--dump-config"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert 'preset_name = "eco"' in out.stdout
+    assert "[refinement]" in out.stdout

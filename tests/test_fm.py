@@ -1,0 +1,60 @@
+"""k-way FM refiner tests (reference: fm_refiner.cc exercised through
+shm endtoend tests; here directly)."""
+
+import numpy as np
+
+from kaminpar_tpu.context import FMContext
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.partitioned import PartitionedGraph
+from kaminpar_tpu.refinement.fm_refiner import FMRefiner
+
+
+def _pgraph(g, k, part, eps=0.1):
+    W = int(np.asarray(g.node_w).sum())
+    per = int(np.ceil(W / k) * (1 + eps)) + int(np.asarray(g.node_w).max())
+    return PartitionedGraph.create(g, k, part, np.full(k, per, dtype=np.int64))
+
+
+def test_fm_improves_noisy_grid():
+    g = generators.grid2d_graph(16, 16)
+    rng = np.random.default_rng(0)
+    part = (np.arange(256) // 64).astype(np.int32)
+    flip = rng.random(256) < 0.25
+    part[flip] = rng.integers(0, 4, flip.sum())
+    pg = _pgraph(g, 4, part)
+    before = pg.edge_cut()
+    out = FMRefiner(FMContext()).refine(pg)
+    assert out.edge_cut() < before
+    assert out.is_feasible()
+
+
+def test_fm_improves_rmat_vs_lp_alone():
+    """FM escapes local minima LP can't (negative-gain move chains)."""
+    from kaminpar_tpu.context import LabelPropagationContext
+    from kaminpar_tpu.refinement.lp_refiner import LPRefiner
+
+    g = generators.rmat_graph(9, 8, seed=2)
+    rng = np.random.default_rng(2)
+    part = rng.integers(0, 4, g.n).astype(np.int32)
+    pg = _pgraph(g, 4, part)
+    lp_out = LPRefiner(LabelPropagationContext(num_iterations=8)).refine(pg)
+    fm_out = FMRefiner(FMContext()).refine(lp_out)
+    assert fm_out.edge_cut() <= lp_out.edge_cut()
+    assert fm_out.is_feasible()
+
+
+def test_fm_skips_large_graphs():
+    g = generators.grid2d_graph(16, 16)
+    part = (np.arange(256) // 64).astype(np.int32)
+    pg = _pgraph(g, 4, part)
+    out = FMRefiner(FMContext(max_n=100)).refine(pg)
+    assert np.array_equal(np.asarray(out.partition), np.asarray(pg.partition))
+
+
+def test_fm_respects_budgets_tight():
+    g = generators.grid2d_graph(8, 8)
+    part = (np.arange(64) // 16).astype(np.int32)
+    pg = PartitionedGraph.create(g, 4, part, np.full(4, 17, dtype=np.int64))
+    out = FMRefiner(FMContext()).refine(pg)
+    bw = np.asarray(out.block_weights())
+    assert (bw <= 17).all(), bw
