@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-timer-depth", type=int, default=3)
     p.add_argument("--use-64bit", action="store_true",
                    help="64-bit node/edge ids and weights")
+    p.add_argument("--vcycles", default=None, metavar="K1,K2,...",
+                   help="intermediate k values for the vcycle presets "
+                        "(reference: --vcycles)")
+    p.add_argument("--heap-profile", action="store_true",
+                   help="print device allocator statistics after partitioning")
     p.add_argument("-C", "--config", default=None, metavar="FILE",
                    help="load a TOML config over the chosen preset")
     p.add_argument("--dump-config", action="store_true",
@@ -98,6 +103,12 @@ def main(argv=None) -> int:
         ctx.seed = args.seed
     if args.use_64bit:
         ctx.use_64bit_ids = True
+    if args.vcycles:
+        ctx.vcycles = tuple(int(s) for s in args.vcycles.split(","))
+    if args.heap_profile:
+        from .utils.heap_profiler import HeapProfiler
+
+        HeapProfiler.reset(enabled=True)
 
     t0 = time.perf_counter()
     graph = kio.read_graph(args.graph, args.format, use_64bit=ctx.use_64bit_ids)
@@ -133,6 +144,10 @@ def main(argv=None) -> int:
         kio.write_block_sizes(
             args.block_sizes, args.k, part, np.asarray(graph.node_w)
         )
+    if args.heap_profile:
+        from .utils.heap_profiler import HeapProfiler
+
+        Logger.log(HeapProfiler.report())
     return 0
 
 

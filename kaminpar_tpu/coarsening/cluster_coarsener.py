@@ -26,6 +26,7 @@ from .max_cluster_weights import compute_max_cluster_weight
 class CoarseLevel:
     graph: CSRGraph  # the coarse graph produced at this level
     coarse_of: object  # fine-node -> coarse-node map (device array)
+    communities: object = None  # per-coarse-node community id (v-cycle mode)
 
 
 class ClusterCoarsener:
@@ -33,14 +34,35 @@ class ClusterCoarsener:
         self.ctx = ctx
         self.input_graph = graph
         self.hierarchy: List[CoarseLevel] = []
+        # v-cycle mode: per-node community ids of the *input* graph; LP never
+        # merges across communities (reference: VcycleDeepMultilevelPartitioner
+        # + accept_neighbor, lp_refiner.cc:108-110).
+        self.input_communities = None
         if ctx.coarsening.algorithm == ClusteringAlgorithm.LP:
             self.clusterer: Optional[LPClustering] = LPClustering(ctx.coarsening.lp)
+        elif ctx.coarsening.algorithm == ClusteringAlgorithm.HEM:
+            from .hem_clusterer import HEMClustering
+
+            self.clusterer = HEMClustering(ctx.coarsening.lp)
         else:
             self.clusterer = None
+
+    def set_communities(self, communities) -> None:
+        import jax.numpy as jnp
+
+        self.input_communities = jnp.asarray(communities)
 
     @property
     def current_graph(self) -> CSRGraph:
         return self.hierarchy[-1].graph if self.hierarchy else self.input_graph
+
+    @property
+    def current_communities(self):
+        return (
+            self.hierarchy[-1].communities
+            if self.hierarchy
+            else self.input_communities
+        )
 
     @property
     def num_levels(self) -> int:
@@ -68,8 +90,48 @@ class ClusterCoarsener:
             avg_w = graph.total_node_weight / max(graph.n, 1)
             max_cw = min(max_cw, max(int(sf * avg_w), 1))
         with scoped_timer("coarsening"):
-            labels = self.clusterer.compute_clustering(graph, max_cw)
+            comm = self.current_communities
+            if comm is not None:
+                # Zero out cross-community edges for the *clustering* only:
+                # ratings must be > 0, so LP can never adopt a label across
+                # a community boundary.  Isolated/two-hop passes merge
+                # arbitrary nodes and must stay off.  Contraction below uses
+                # the true weights.
+                import dataclasses as _dc
+
+                import jax.numpy as jnp
+
+                masked_ew = jnp.where(
+                    comm[graph.edge_u] == comm[graph.col_idx], graph.edge_w, 0
+                )
+                cluster_graph = CSRGraph(
+                    graph.row_ptr, graph.col_idx, graph.node_w, masked_ew,
+                    sorted_by_degree=graph.sorted_by_degree, edge_u=graph.edge_u,
+                )
+                if isinstance(self.clusterer, LPClustering):
+                    clusterer = LPClustering(
+                        _dc.replace(
+                            self.ctx.coarsening.lp,
+                            cluster_isolated_nodes=False,
+                            cluster_two_hop_nodes=False,
+                        )
+                    )
+                else:
+                    # HEM's eligibility already requires w > 0, so the masked
+                    # weights are all the restriction it needs.
+                    clusterer = self.clusterer
+                labels = clusterer.compute_clustering(cluster_graph, max_cw)
+            else:
+                labels = self.clusterer.compute_clustering(graph, max_cw)
             coarse, coarse_of = contract_clustering(graph, labels)
+            coarse_comm = None
+            if comm is not None:
+                # Clusters never span communities, so any member's id works.
+                import jax
+
+                coarse_comm = jax.ops.segment_max(
+                    comm, coarse_of, num_segments=coarse.n
+                )
         shrink = 1.0 - coarse.n / max(graph.n, 1)
         Logger.log(
             f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "
@@ -78,7 +140,7 @@ class ClusterCoarsener:
         )
         if shrink < self.ctx.coarsening.convergence_threshold:
             return False
-        self.hierarchy.append(CoarseLevel(coarse, coarse_of))
+        self.hierarchy.append(CoarseLevel(coarse, coarse_of, coarse_comm))
         return True
 
     def coarsen(self, k: int, epsilon: float, target_n: int) -> CSRGraph:

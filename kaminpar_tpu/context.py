@@ -23,6 +23,7 @@ class PartitioningMode(enum.Enum):
     DEEP = "deep"
     RB = "rb"
     KWAY = "kway"
+    VCYCLE = "vcycle"
 
 
 class ClusteringAlgorithm(enum.Enum):
@@ -30,6 +31,7 @@ class ClusteringAlgorithm(enum.Enum):
 
     NOOP = "noop"
     LP = "lp"
+    HEM = "hem"
 
 
 class RefinementAlgorithm(enum.Enum):
@@ -262,8 +264,15 @@ class ParallelContext:
 
 @dataclass
 class DebugContext:
+    """Reference: the debug dump options consumed by
+    kaminpar-shm/partitioning/debug.cc."""
+
     save_hierarchy: bool = False
     validate_graph: bool = False
+    graph_name: str = ""
+    dump_dir: str = "."
+    dump_graph_hierarchy: bool = False
+    dump_partition_hierarchy: bool = False
 
 
 @dataclass
@@ -281,6 +290,12 @@ class Context:
     parallel: ParallelContext = field(default_factory=ParallelContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
+    # v-cycle mode: intermediate k values partitioned before the final k
+    # (reference: PartitioningContext::vcycles, vcycle_deep_multilevel.cc).
+    vcycles: tuple = ()
+    # Forbid refinement moves across the previous cycle's blocks
+    # (reference: restrict_vcycle_refinement).
+    restrict_vcycle_refinement: bool = False
     # int32 by default; int64 mirrors the reference's 64-bit ID/weight build
     # switches (CMakeLists.txt:71-79).
     use_64bit_ids: bool = False

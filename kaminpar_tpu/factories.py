@@ -55,4 +55,8 @@ def create_partitioner(ctx: Context, graph: CSRGraph):
         return DeepMultilevelPartitioner(ctx, graph)
     if ctx.mode == PartitioningMode.RB:
         return RBMultilevelPartitioner(ctx, graph)
+    if ctx.mode == PartitioningMode.VCYCLE:
+        from .partitioning.vcycle import VcycleDeepMultilevelPartitioner
+
+        return VcycleDeepMultilevelPartitioner(ctx, graph)
     raise ValueError(f"unhandled partitioning mode {ctx.mode}")

@@ -86,6 +86,7 @@ class CSRGraph:
         edge_w=None,
         *,
         sorted_by_degree: bool = False,
+        edge_u=None,
     ):
         self.row_ptr = jnp.asarray(row_ptr)
         self.col_idx = jnp.asarray(col_idx)
@@ -102,7 +103,10 @@ class CSRGraph:
         self.m = m
         self.sorted_by_degree = sorted_by_degree
         # Source endpoint per CSR slot: edge_u[e] = u for e in [row_ptr[u], row_ptr[u+1]).
-        self.edge_u = _compute_edge_u(self.row_ptr, m)
+        # Callers sharing structure with another graph can pass its edge_u.
+        self.edge_u = (
+            _compute_edge_u(self.row_ptr, m) if edge_u is None else jnp.asarray(edge_u)
+        )
         self._total_node_weight: Optional[int] = None
         self._max_node_weight: Optional[int] = None
         self._total_edge_weight: Optional[int] = None

@@ -63,9 +63,45 @@ def extend_partition(
 
 
 class DeepMultilevelPartitioner:
-    def __init__(self, ctx: Context, graph: CSRGraph):
+    def __init__(
+        self,
+        ctx: Context,
+        graph: CSRGraph,
+        communities=None,
+        communities_k: int = 0,
+    ):
+        """``communities`` (v-cycle mode): per-node block ids of a previous
+        cycle's ``communities_k``-way partition.  Coarsening then never
+        merges across communities and the coarsest graph inherits the
+        community assignment as its initial partition (reference:
+        DeepInitialPartitioningMode::COMMUNITIES,
+        vcycle_deep_multilevel.cc:113-121)."""
         self.ctx = ctx
         self.graph = graph
+        self.communities = communities
+        self.communities_k = communities_k
+
+    def _restrict(self, p_graph: PartitionedGraph, pre_part, cur_k: int, communities):
+        """Restricted v-cycle refinement: revert moves that crossed the
+        previous cycle's block boundaries (reference:
+        restrict_vcycle_refinement, vcycle_deep_multilevel.cc:132-152)."""
+        if (
+            not self.ctx.restrict_vcycle_refinement
+            or communities is None
+            or self.communities_k <= 0
+        ):
+            return p_graph
+        k = self.ctx.partition.k
+        off_cur = split_offsets(k, cur_k)
+        off_prev = split_offsets(k, self.communities_k)
+        blk_comm = np.searchsorted(off_prev, off_cur[:cur_k], side="right") - 1
+        part = np.asarray(p_graph.partition)
+        comm = np.asarray(communities)
+        bad = blk_comm[part] != comm
+        if bad.any():
+            part = np.where(bad, np.asarray(pre_part), part)
+            p_graph = p_graph.with_partition(part)
+        return p_graph
 
     def _refine(self, graph: CSRGraph, part, cur_k: int, coarse: bool) -> PartitionedGraph:
         max_bw = intermediate_block_weights(
@@ -99,6 +135,9 @@ class DeepMultilevelPartitioner:
         C = ctx.coarsening.contraction_limit
         coarsener = ClusterCoarsener(ctx, self.graph)
 
+        if self.communities is not None:
+            coarsener.set_communities(self.communities)
+
         with scoped_timer("partitioning"):
             coarsest = coarsener.coarsen(k, ctx.partition.epsilon, 2 * C)
             cur_k = min(k, compute_k_for_n(coarsest.n, C, k))
@@ -108,18 +147,31 @@ class DeepMultilevelPartitioner:
                 OutputLevel.DEBUG,
             )
 
-            host = graph_to_host(coarsest)
             rng = RandomState.numpy_rng()
-            budgets = intermediate_block_weights(
-                np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
-            )
-            with scoped_timer("initial_partitioning"):
-                part = recursive_bipartition(
-                    host, cur_k, budgets, rng, ctx.initial_partitioning
+            if self.communities is not None:
+                # v-cycle: the coarsest partition is the (projected) previous
+                # cycle's partition; extension grows it toward k on the way up.
+                cur_k = self.communities_k
+                part = np.asarray(coarsener.current_communities, dtype=np.int32)
+                with scoped_timer("initial_partitioning"):
+                    pass
+            else:
+                host = graph_to_host(coarsest)
+                budgets = intermediate_block_weights(
+                    np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
                 )
+                with scoped_timer("initial_partitioning"):
+                    part = recursive_bipartition(
+                        host, cur_k, budgets, rng, ctx.initial_partitioning
+                    )
             p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
+            p_graph = self._restrict(
+                p_graph, part, cur_k, coarsener.current_communities
+            )
 
             debug = Logger.level.value >= OutputLevel.DEBUG.value
+
+            from ..utils import debug as debug_dumps
 
             while True:
                 graph = coarsener.current_graph
@@ -139,6 +191,9 @@ class DeepMultilevelPartitioner:
                         pre_over = _m.total_overload(graph, part, target_k, mb)
                     cur_k = target_k
                     p_graph = self._refine(graph, part, cur_k, coarsener.num_levels > 0)
+                    p_graph = self._restrict(
+                        p_graph, part, cur_k, coarsener.current_communities
+                    )
                     if debug:
                         Logger.log(
                             f"  deep: n={graph.n} extended k->{cur_k}: cut "
@@ -148,6 +203,8 @@ class DeepMultilevelPartitioner:
                         )
                 if coarsener.num_levels == 0:
                     break
+                debug_dumps.dump_graph_hierarchy(graph, coarsener.num_levels, ctx)
+                debug_dumps.dump_partition_hierarchy(p_graph, coarsener.num_levels, ctx)
                 fine_part = coarsener.uncoarsen(p_graph.partition)
                 if debug:
                     pre = PartitionedGraph.create(
@@ -157,11 +214,16 @@ class DeepMultilevelPartitioner:
                 p_graph = self._refine(
                     coarsener.current_graph, fine_part, cur_k, coarsener.num_levels > 0
                 )
+                p_graph = self._restrict(
+                    p_graph, fine_part, cur_k, coarsener.current_communities
+                )
                 if debug:
                     Logger.log(
                         f"  deep: n={coarsener.current_graph.n} k={cur_k} projected: "
                         f"cut {pre} -> refined {p_graph.edge_cut()}",
                         OutputLevel.DEBUG,
                     )
+
+            debug_dumps.dump_partition_hierarchy(p_graph, 0, ctx)
 
         return p_graph

@@ -1,0 +1,86 @@
+"""V-cycle deep multilevel partitioning.
+
+Reference: ``kaminpar-shm/partitioning/deep/vcycle_deep_multilevel.cc`` —
+partition for an increasing sequence of k values (``ctx.vcycles`` + the
+final k); each cycle's partition becomes the *communities* of the next:
+coarsening never merges across communities, and the coarsest graph inherits
+the community assignment as its initial partition
+(DeepInitialPartitioningMode::COMMUNITIES).  Each cycle's block budgets are
+the aggregates of the next cycle's budgets (vcycle_deep_multilevel.cc:
+compute_max_block_weights), which our :func:`intermediate_block_weights`
+computes via the recursive-bisection split offsets; cycle k values must
+therefore refine each other under that split (powers-of-two sequences and
+divisors of k always do — a documented restriction vs the reference's
+expanded-blocks arithmetic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..context import Context
+from ..graph.csr import CSRGraph
+from ..graph.partitioned import PartitionedGraph
+from ..utils.logger import Logger, OutputLevel
+from .deep import DeepMultilevelPartitioner
+from .partition_utils import intermediate_block_weights, split_offsets
+
+
+class VcycleDeepMultilevelPartitioner:
+    def __init__(self, ctx: Context, graph: CSRGraph):
+        self.ctx = ctx
+        self.graph = graph
+
+    def partition(self) -> PartitionedGraph:
+        ctx = self.ctx
+        k = ctx.partition.k
+        steps = [int(s) for s in ctx.vcycles] + [k]
+        if len(steps) == 1:
+            Logger.log(
+                "vcycle: ctx.vcycles is empty — running a single deep cycle "
+                "(set --vcycles / [vcycles] to enable intermediate cycles)",
+                OutputLevel.APPLICATION,
+            )
+        final_bw = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
+
+        # Validate the refinement property once up front.
+        for prev_k, cur_k in zip(steps, steps[1:]):
+            off_prev = split_offsets(k, prev_k)
+            off_cur = split_offsets(k, cur_k)
+            if not np.array_equal(np.intersect1d(off_prev, off_cur), off_prev):
+                raise ValueError(
+                    f"v-cycle step {prev_k} -> {cur_k} does not refine under "
+                    "recursive bisection; use powers of two or divisors of k"
+                )
+
+        communities = None
+        communities_k = 0
+        p_graph = None
+        import copy
+
+        for step_k in steps:
+            cycle_ctx = copy.deepcopy(ctx)
+            cycle_ctx.partition.k = step_k
+            cycle_ctx.partition.max_block_weights = intermediate_block_weights(
+                final_bw, step_k
+            )
+            cycle_ctx.partition.min_block_weights = (
+                ctx.partition.min_block_weights if step_k == k else None
+            )
+            Logger.log(
+                f"  vcycle: partitioning for k={step_k}"
+                + (f" (communities k={communities_k})" if communities is not None else ""),
+                OutputLevel.DEBUG,
+            )
+            partitioner = DeepMultilevelPartitioner(
+                cycle_ctx, self.graph, communities=communities,
+                communities_k=communities_k,
+            )
+            p_graph = partitioner.partition()
+            communities = np.asarray(p_graph.partition)
+            communities_k = step_k
+
+        return PartitionedGraph.create(
+            self.graph, k, p_graph.partition, ctx.partition.max_block_weights,
+            ctx.partition.min_block_weights,
+        )

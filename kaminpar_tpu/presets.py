@@ -141,6 +141,17 @@ def create_largek_strong_context() -> Context:
     return ctx
 
 
+def create_vcycle_context(restricted: bool = False) -> Context:
+    """Reference: ``create_vcycle_context(restricted)`` (presets.cc
+    "vcycle"/"restricted-vcycle"): deep multilevel driven through
+    intermediate-k cycles; each cycle's partition constrains the next."""
+    ctx = create_default_context()
+    ctx.preset_name = "restricted-vcycle" if restricted else "vcycle"
+    ctx.mode = PartitioningMode.VCYCLE
+    ctx.restrict_vcycle_refinement = restricted
+    return ctx
+
+
 def create_linear_time_kway_context() -> Context:
     """Reference: ``create_linear_time_kway_context`` — single-shot k-way
     with LP-only refinement for linear total work."""
@@ -186,6 +197,8 @@ _PRESETS = {
     "kway": create_kway_context,
     "mtkahypar-kway": create_kway_context,
     "linear-time-kway": create_linear_time_kway_context,
+    "vcycle": create_vcycle_context,
+    "restricted-vcycle": lambda: create_vcycle_context(True),
 }
 
 

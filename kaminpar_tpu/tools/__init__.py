@@ -1,0 +1,7 @@
+"""Command-line graph tools.
+
+Reference: ``apps/tools/`` — GraphPropertiesTool, PartitionPropertiesTool,
+ConnectedComponentsTool, GraphRearrangementTool (GraphCompressionTool is
+covered by the compression subpackage once graphs can be stored
+compressed).  Invoke as ``python -m kaminpar_tpu.tools <tool> ...``.
+"""

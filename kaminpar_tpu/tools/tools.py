@@ -1,0 +1,120 @@
+"""Tool implementations (reference: apps/tools/*.cc)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def _read(path: str):
+    from ..io import read_graph
+
+    return read_graph(path)
+
+
+def graph_properties(argv) -> int:
+    """Reference: GraphPropertiesTool.cc — structural summary of a graph."""
+    p = argparse.ArgumentParser(prog="graph-properties")
+    p.add_argument("graph")
+    args = p.parse_args(argv)
+    g = _read(args.graph)
+    deg = np.diff(np.asarray(g.row_ptr))
+    nw = np.asarray(g.node_w)
+    ew = np.asarray(g.edge_w)
+    print(f"Graph: {args.graph}")
+    print(f"  n: {g.n}")
+    print(f"  m: {g.m // 2} (undirected)")
+    print(f"  total node weight: {nw.sum()}  max: {nw.max() if g.n else 0}")
+    print(f"  total edge weight: {ew.sum() // 2}")
+    print(f"  degrees: min={deg.min() if g.n else 0} max={deg.max() if g.n else 0} "
+          f"avg={deg.mean():.2f} median={np.median(deg):.0f}")
+    print(f"  isolated nodes: {(deg == 0).sum()}")
+    print(f"  node weighted: {bool((nw != 1).any())}  "
+          f"edge weighted: {bool((ew != 1).any())}")
+    return 0
+
+
+def partition_properties(argv) -> int:
+    """Reference: PartitionPropertiesTool.cc — quality metrics of a
+    partition file (one block id per line)."""
+    p = argparse.ArgumentParser(prog="partition-properties")
+    p.add_argument("graph")
+    p.add_argument("partition")
+    p.add_argument("-e", "--epsilon", type=float, default=0.03)
+    args = p.parse_args(argv)
+    g = _read(args.graph)
+    part = np.loadtxt(args.partition, dtype=np.int64).reshape(-1)
+    if len(part) != g.n:
+        print(f"error: partition has {len(part)} entries, graph has {g.n} nodes")
+        return 1
+    from ..graph import metrics
+
+    k = int(part.max()) + 1
+    W = int(np.asarray(g.node_w).sum())
+    perfect = -(W // -k)
+    max_bw = np.full(k, max(int((1 + args.epsilon) * perfect), perfect + 1))
+    bw = np.asarray(metrics.block_weights(g, part, k))
+    print(f"Partition: {args.partition}")
+    print(f"  k: {k}")
+    print(f"  cut: {metrics.edge_cut(g, part)}")
+    print(f"  imbalance: {metrics.imbalance(g, part, k):.6f}")
+    print(f"  feasible (eps={args.epsilon}): "
+          f"{metrics.is_feasible(g, part, k, max_bw)}")
+    print(f"  block weights: min={bw.min()} max={bw.max()} avg={bw.mean():.1f}")
+    return 0
+
+
+def connected_components(argv) -> int:
+    """Reference: ConnectedComponentsTool.cc — component count + sizes."""
+    p = argparse.ArgumentParser(prog="connected-components")
+    p.add_argument("graph")
+    args = p.parse_args(argv)
+    g = _read(args.graph)
+    # Union-find with path halving (host; the tool is IO-bound anyway).
+    parent = np.arange(g.n, dtype=np.int64)
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    u_arr = np.repeat(np.arange(g.n), np.diff(np.asarray(g.row_ptr)))
+    for a, b in zip(u_arr, np.asarray(g.col_idx)):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+    roots = np.array([find(x) for x in range(g.n)])
+    _, sizes = np.unique(roots, return_counts=True)
+    sizes = np.sort(sizes)[::-1]
+    print(f"Components: {len(sizes)}")
+    print(f"  largest: {sizes[:5].tolist()}")
+    print(f"  singletons: {(sizes == 1).sum()}")
+    return 0
+
+
+def rearrange(argv) -> int:
+    """Reference: GraphRearrangementTool.cc — write the degree-bucket
+    permuted graph (the layout the partitioner uses internally)."""
+    p = argparse.ArgumentParser(prog="rearrange")
+    p.add_argument("graph")
+    p.add_argument("output")
+    args = p.parse_args(argv)
+    from ..graph.csr import rearrange_by_degree_buckets
+    from ..io.metis import write_metis
+
+    g = _read(args.graph)
+    gg, perm = rearrange_by_degree_buckets(g)
+    write_metis(gg, args.output)
+    np.savetxt(args.output + ".perm", perm, fmt="%d")
+    print(f"wrote {args.output} (+ .perm with old->new node mapping)")
+    return 0
+
+
+REGISTRY = {
+    "graph-properties": graph_properties,
+    "partition-properties": partition_properties,
+    "connected-components": connected_components,
+    "rearrange": rearrange,
+}

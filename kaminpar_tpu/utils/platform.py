@@ -17,6 +17,40 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+def prefer_working_backend(timeout_s: float = 20.0) -> str:
+    """Pick a backend that actually initializes: try the ambient choice
+    (TPU when available) in a watchdog thread; fall back to CPU when init
+    errors *or hangs* (the axon tunnel fails both ways).  Returns the
+    platform name.  Safe to call before any jax use; used by offline entry
+    points (tools, bench) that must never wedge on a dead tunnel."""
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu"
+
+    result: list = []
+
+    def probe():
+        try:
+            result.append(jax.devices()[0].platform)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result:
+        return result[0]
+    # Hung or failed: force CPU for the rest of the process.  (If the probe
+    # is hung inside backend init, the CPU platform still initializes
+    # independently.)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
 def force_cpu_devices(n_devices: int) -> list:
     """Force the CPU platform with at least ``n_devices`` virtual devices.
 

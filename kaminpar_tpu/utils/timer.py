@@ -112,6 +112,10 @@ class Timer:
 
 @contextmanager
 def scoped_timer(name: str):
-    """``SCOPED_TIMER`` equivalent (timer.h macro API)."""
+    """``SCOPED_TIMER`` + ``SCOPED_HEAP_PROFILER`` equivalent (timer.h /
+    heap_profiler.h macro APIs — the reference pairs them on every scope)."""
+    from .heap_profiler import HeapProfiler
+
     with Timer.global_().scope(name):
-        yield
+        with HeapProfiler.scope(name):
+            yield

@@ -1,8 +1,15 @@
 """TOML config round-trip tests (reference: the CLI11 --dump-config/-C
 machinery used by apps/KaMinPar.cc)."""
 
+import os
 import subprocess
 import sys
+
+# Subprocesses must not try the (possibly hung) TPU tunnel backend; the
+# axon site hook (PYTHONPATH) force-connects it even under JAX_PLATFORMS=cpu,
+# so it must be stripped too.
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": "/root/repo"}
 
 from kaminpar_tpu.config import dump_toml, load_toml
 from kaminpar_tpu.context import RefinementAlgorithm
@@ -46,7 +53,7 @@ def test_load_rejects_unknown_key():
 def test_cli_dump_config():
     out = subprocess.run(
         [sys.executable, "-m", "kaminpar_tpu", "-P", "eco", "--dump-config"],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=120, env=_ENV,
     )
     assert out.returncode == 0, out.stderr
     assert 'preset_name = "eco"' in out.stdout

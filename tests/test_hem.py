@@ -1,0 +1,58 @@
+"""HEM clusterer tests (reference: hem_clusterer.cc semantics)."""
+
+import numpy as np
+
+from kaminpar_tpu.context import LabelPropagationContext
+from kaminpar_tpu.coarsening.hem_clusterer import HEMClustering
+from kaminpar_tpu.graph import generators
+
+
+def _labels(g, max_cw=100):
+    hem = HEMClustering(LabelPropagationContext())
+    lab = np.asarray(hem.compute_clustering(g, max_cw))[: g.n]
+    return lab
+
+
+def test_hem_produces_valid_matching():
+    g = generators.grid2d_graph(16, 16)
+    lab = _labels(g)
+    # every cluster has size <= 2 (matching, not clustering)
+    sizes = np.bincount(lab)
+    assert sizes.max() <= 2
+    # most nodes matched on a grid
+    n_clusters = len(np.unique(lab))
+    assert n_clusters <= 0.75 * g.n, n_clusters
+
+
+def test_hem_prefers_heavy_edges():
+    # path 0-1-2-3 with edge weights 1, 100, 1: the heavy pair (1,2) must match
+    row_ptr = np.array([0, 1, 3, 5, 6])
+    col_idx = np.array([1, 0, 2, 1, 3, 2])
+    edge_w = np.array([1, 1, 100, 100, 1, 1])
+    from kaminpar_tpu.graph.csr import CSRGraph
+
+    g = CSRGraph(row_ptr, col_idx, None, edge_w)
+    lab = _labels(g)
+    assert lab[1] == lab[2]
+    assert lab[0] != lab[1] and lab[3] != lab[2]
+
+
+def test_hem_respects_weight_cap():
+    g = generators.grid2d_graph(8, 8, node_weights=np.full(64, 10))
+    lab = _labels(g, max_cw=15)  # no pair fits (10+10 > 15)
+    assert len(np.unique(lab)) == 64
+
+
+def test_hem_in_pipeline():
+    from kaminpar_tpu.context import ClusteringAlgorithm
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.algorithm = ClusteringAlgorithm.HEM
+    g = generators.rgg2d_graph(1024, seed=6)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    part = s.compute_partition(k=4)
+    assert metrics.is_feasible(g, part, 4, s.ctx.partition.max_block_weights)

@@ -1,0 +1,91 @@
+"""Tools + heap profiler + debug dump tests (reference: apps/tools/,
+heap_profiler.h, partitioning/debug.cc)."""
+
+import os
+import subprocess
+import sys
+
+# Subprocesses must not try the (possibly hung) TPU tunnel backend; the
+# axon site hook (PYTHONPATH) force-connects it even under JAX_PLATFORMS=cpu,
+# so it must be stripped too.
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": "/root/repo"}
+
+import numpy as np
+
+
+def _run_tool(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "kaminpar_tpu.tools", *args],
+        capture_output=True, text=True, timeout=300, env=_ENV,
+    )
+
+
+def test_graph_properties_tool():
+    out = _run_tool("graph-properties", "/root/reference/misc/rgg2d.metis")
+    assert out.returncode == 0, out.stderr
+    assert "n: 1024" in out.stdout
+    assert "m: 4113" in out.stdout
+
+
+def test_partition_properties_tool(tmp_path):
+    part = np.zeros(1024, dtype=np.int64)
+    part[512:] = 1
+    pfile = tmp_path / "p.part"
+    np.savetxt(pfile, part, fmt="%d")
+    out = _run_tool(
+        "partition-properties", "/root/reference/misc/rgg2d.metis", str(pfile)
+    )
+    assert out.returncode == 0, out.stderr
+    assert "k: 2" in out.stdout
+    assert "cut:" in out.stdout
+
+
+def test_connected_components_tool():
+    out = _run_tool("connected-components", "/root/reference/misc/rgg2d.metis")
+    assert out.returncode == 0, out.stderr
+    assert "Components:" in out.stdout
+
+
+def test_rearrange_tool(tmp_path):
+    out_file = tmp_path / "rearranged.metis"
+    out = _run_tool("rearrange", "/root/reference/misc/rgg2d.metis", str(out_file))
+    assert out.returncode == 0, out.stderr
+    from kaminpar_tpu.io.metis import read_metis
+
+    g = read_metis(str(out_file))
+    assert g.n == 1024
+
+
+def test_heap_profiler_scopes():
+    from kaminpar_tpu.utils.heap_profiler import HeapProfiler, memory_summary
+
+    HeapProfiler.reset(enabled=True)
+    with HeapProfiler.scope("outer"):
+        with HeapProfiler.scope("inner"):
+            import jax.numpy as jnp
+
+            _ = jnp.ones(1000).sum()
+    rep = HeapProfiler.report()
+    assert "outer" in rep and "inner" in rep
+    assert isinstance(memory_summary(), dict)
+
+
+def test_debug_dumps(tmp_path):
+    from kaminpar_tpu.graph import generators
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    ctx.debug.dump_dir = str(tmp_path)
+    ctx.debug.graph_name = "t"
+    ctx.debug.dump_graph_hierarchy = True
+    ctx.debug.dump_partition_hierarchy = True
+    ctx.coarsening.contraction_limit = 100  # force >= 1 coarse level
+    g = generators.rgg2d_graph(1024, seed=1)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    s.compute_partition(k=4)
+    dumps = list(tmp_path.iterdir())
+    assert any(p.suffix == ".metis" for p in dumps), dumps
+    assert any(p.suffix == ".part" for p in dumps), dumps

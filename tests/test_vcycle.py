@@ -1,0 +1,48 @@
+"""V-cycle scheme tests (reference: vcycle_deep_multilevel.cc)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators, metrics
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+
+
+@pytest.mark.parametrize("preset", ["vcycle", "restricted-vcycle"])
+def test_vcycle_end_to_end(preset):
+    ctx = create_context_by_preset_name(preset)
+    ctx.vcycles = (4,)
+    g = generators.rgg2d_graph(2048, seed=3)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    part = s.compute_partition(k=16, epsilon=0.05)
+    assert metrics.is_feasible(g, part, 16, s.ctx.partition.max_block_weights)
+    assert len(np.unique(part)) == 16
+
+
+def test_vcycle_quality_not_worse_than_default():
+    g = generators.rgg2d_graph(2048, seed=4)
+    s0 = KaMinPar("default")
+    s0.set_graph(g)
+    p0 = s0.compute_partition(k=8)
+    cut0 = metrics.edge_cut(g, p0)
+
+    ctx = create_context_by_preset_name("vcycle")
+    ctx.vcycles = (2,)
+    s1 = KaMinPar(ctx)
+    s1.set_graph(g)
+    p1 = s1.compute_partition(k=8)
+    cut1 = metrics.edge_cut(g, p1)
+    assert cut1 <= 1.25 * cut0, (cut1, cut0)
+
+
+def test_vcycle_rejects_non_refining_steps():
+    # 3 -> 4 does not refine under recursive bisection (offsets [0,6,11,16]
+    # vs [0,4,8,12,16] share only the endpoints)
+    ctx = create_context_by_preset_name("vcycle")
+    ctx.vcycles = (3, 4)
+    g = generators.grid2d_graph(16, 16)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    with pytest.raises(ValueError, match="refine"):
+        s.compute_partition(k=16)
