@@ -1,0 +1,90 @@
+"""Distributed partition metrics as on-device psum reductions.
+
+Reference: ``kaminpar-dist/metrics.cc:100`` — cut/imbalance are
+``MPI_Allreduce`` sums of per-PE local contributions; here each shard
+reduces its local edges/nodes inside ``shard_map`` and one ``psum`` rides
+the mesh (VERDICT r1 row 51: previously the cut was computed on host
+after a full gather).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .exchange import ghost_exchange
+from .lp import _neighbor_labels
+
+AXIS = "nodes"
+
+
+_CACHE: dict = {}
+
+
+def make_dist_metrics(mesh: Mesh, *, k: int):
+    """Build the jitted (cut, block_weights) reducer for a mesh (cached
+    per (mesh, k) so repeated metric calls reuse the compiled program)."""
+    key = (id(mesh), k)
+    if key in _CACHE:
+        return _CACHE[key]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P()),
+    )
+    def metrics_fn(labels, node_w, edge_u, col_loc, edge_w, send_idx, recv_map):
+        ghost_labels = ghost_exchange(
+            labels, send_idx, recv_map, fill=jnp.asarray(0, labels.dtype)
+        )
+        nbr = _neighbor_labels(labels, ghost_labels, col_loc, 0)
+        own = labels[edge_u]
+        # Pad edges have weight 0, so no masking is needed.  Every
+        # undirected edge is stored twice (once per endpoint), so the
+        # psum double-counts and we halve outside.
+        local_cut = jnp.sum(jnp.where(own != nbr, edge_w, 0))
+        cut2 = jax.lax.psum(local_cut, AXIS)
+        bw = jax.lax.psum(
+            jax.ops.segment_sum(node_w, labels.astype(jnp.int32), num_segments=k),
+            AXIS,
+        )
+        return cut2, bw
+
+    fn = jax.jit(metrics_fn)
+    _CACHE[key] = fn
+    return fn
+
+
+def dist_edge_cut(mesh: Mesh, labels, graph, *, k: int) -> int:
+    """Global edge cut of a sharded partition (one device program)."""
+    cut2, _ = make_dist_metrics(mesh, k=k)(
+        labels, graph.node_w, graph.edge_u, graph.col_loc, graph.edge_w,
+        graph.send_idx, graph.recv_map,
+    )
+    return int(cut2) // 2
+
+
+def dist_block_weights(mesh: Mesh, labels, graph, *, k: int) -> np.ndarray:
+    _, bw = make_dist_metrics(mesh, k=k)(
+        labels, graph.node_w, graph.edge_u, graph.col_loc, graph.edge_w,
+        graph.send_idx, graph.recv_map,
+    )
+    return np.asarray(bw)
+
+
+def dist_imbalance(mesh: Mesh, labels, graph, *, k: int) -> float:
+    bw = dist_block_weights(mesh, labels, graph, k=k)
+    total = int(bw.sum())
+    perfect = -(total // -k) if k else 1
+    return float(bw.max() / perfect - 1.0) if perfect > 0 else 0.0
+
+
+def dist_is_feasible(mesh: Mesh, labels, graph, max_block_weights, *, k: int) -> bool:
+    bw = dist_block_weights(mesh, labels, graph, k=k)
+    return bool((bw <= np.asarray(max_block_weights)).all())

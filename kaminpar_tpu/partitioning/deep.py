@@ -18,7 +18,7 @@ from ..context import Context
 from ..factories import create_refiner
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
-from ..initial.bipartitioner import extract_subgraph, recursive_bipartition
+from ..initial.bipartitioner import extract_all_subgraphs, recursive_bipartition
 from ..utils import RandomState
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
@@ -45,10 +45,11 @@ def extend_partition(
     host = graph_to_host(graph)
     rng = RandomState.numpy_rng()
     out = np.zeros(graph.n, dtype=np.int32)
+    subgraphs = extract_all_subgraphs(host, part, cur_k)
     for b in range(cur_k):
         lo, hi = int(lo_of[b]), int(lo_of[b + 1])
         sub_k = hi - lo
-        sub, nodes = extract_subgraph(host, part, b)
+        sub, nodes = subgraphs[b]
         if sub_k <= 1:
             out[nodes] = lo
             continue
