@@ -39,6 +39,7 @@ class RefinementAlgorithm(enum.Enum):
 
     NOOP = "noop"
     LP = "lp"
+    CLP = "clp"  # colored LP
     JET = "jet"
     KWAY_FM = "kway-fm"
     OVERLOAD_BALANCER = "overload-balancer"
@@ -177,6 +178,17 @@ class BalancerContext:
 
 
 @dataclass
+class ColoredLPContext:
+    """Colored LP refiner parameters (reference: ``ColoredLPRefinementContext``,
+    clp_refiner.cc)."""
+
+    num_iterations: int = 2
+    # Zero-gain moves are oscillation-safe inside a color class (independent
+    # set); they restore async-LP boundary diffusion.
+    allow_tie_moves: bool = True
+
+
+@dataclass
 class FMContext:
     """k-way FM refiner parameters (reference: ``KwayFMRefinementContext``,
     presets.cc:348-365)."""
@@ -209,6 +221,7 @@ class RefinementContext:
     jet: JetContext = field(default_factory=JetContext)
     balancer: BalancerContext = field(default_factory=BalancerContext)
     fm: FMContext = field(default_factory=FMContext)
+    clp: ColoredLPContext = field(default_factory=ColoredLPContext)
 
 
 @dataclass

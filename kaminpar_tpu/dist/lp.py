@@ -54,6 +54,66 @@ def _neighbor_labels(labels_loc, ghost_labels, col_loc, fill):
 # ---------------------------------------------------------------------------
 
 
+
+
+def _probabilistic_commit(
+    kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels: int
+):
+    """Probabilistic capacity admission + overweight-rollback fixpoint
+    (shared by the plain and colored refinement rounds; see
+    _refine_round_body for the semantics)."""
+
+    def global_weights(lab_loc):
+        return jax.lax.psum(
+            jax.ops.segment_sum(
+                node_w_loc, lab_loc.astype(jnp.int32), num_segments=num_labels
+            ),
+            AXIS,
+        )
+
+    cluster_w = global_weights(labels_loc)
+    demand = jax.lax.psum(
+        jax.ops.segment_sum(
+            jnp.where(mover, node_w_loc, 0),
+            desired.astype(jnp.int32),
+            num_segments=num_labels,
+        ),
+        AXIS,
+    )
+    remaining = jnp.maximum(lookup(max_w, jnp.arange(num_labels)) - cluster_w, 0)
+    p_accept = jnp.where(demand > 0, remaining / jnp.maximum(demand, 1), 0.0)
+    u = jax.random.uniform(kp, mover.shape)
+    commit = mover & (u < jnp.clip(p_accept[desired], 0.0, 1.0))
+
+    cap = lookup(max_w, jnp.arange(num_labels))
+
+    def overweight_fixable(kept):
+        w = global_weights(jnp.where(kept, desired, labels_loc))
+        arrivals = jax.lax.psum(
+            jax.ops.segment_sum(
+                kept.astype(jnp.int32),
+                desired.astype(jnp.int32),
+                num_segments=num_labels,
+            ),
+            AXIS,
+        )
+        return (w > cap) & (arrivals > 0)
+
+    def cond(carry):
+        _, ow = carry
+        return jnp.any(ow)
+
+    def body(carry):
+        kept, ow = carry
+        kept = kept & ~ow[desired]
+        return kept, overweight_fixable(kept)
+
+    kept, _ = jax.lax.while_loop(cond, body, (commit, overweight_fixable(commit)))
+    final_labels = jnp.where(kept, desired, labels_loc)
+    num_moved = jax.lax.psum(jnp.sum(kept).astype(jnp.int32), AXIS)
+    return final_labels, num_moved
+
+
 def _refine_round_body(
     key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
     recv_map, *, num_labels: int, external_only: bool
@@ -86,54 +146,9 @@ def _refine_round_body(
     )
     desired = jnp.where(tconn > 0, target, labels_loc)
     mover = desired != labels_loc
-
-    # Probabilistic commitment: accept ∝ remaining capacity / global demand.
-    demand = jax.lax.psum(
-        jax.ops.segment_sum(
-            jnp.where(mover, node_w_loc, 0),
-            desired.astype(jnp.int32),
-            num_segments=num_labels,
-        ),
-        AXIS,
+    return _probabilistic_commit(
+        kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels
     )
-    remaining = jnp.maximum(lookup(max_w, jnp.arange(num_labels)) - cluster_w, 0)
-    p_accept = jnp.where(demand > 0, remaining / jnp.maximum(demand, 1), 0.0)
-    u = jax.random.uniform(kp, mover.shape)
-    commit = mover & (u < jnp.clip(p_accept[desired], 0.0, 1.0))
-
-    # Rollback to a feasibility fixpoint: reject in-moves of clusters that
-    # ended overweight; a rejected node returns to its source cluster, which
-    # can itself tip overweight, so iterate until no *fixable* (overweight
-    # with kept in-moves) cluster remains.  Pre-existing overload without
-    # in-moves is the balancer's job, not this round's — excluded from the
-    # loop condition so it cannot spin.
-    cap = lookup(max_w, jnp.arange(num_labels))
-
-    def overweight_fixable(kept):
-        w = global_weights(jnp.where(kept, desired, labels_loc))
-        arrivals = jax.lax.psum(
-            jax.ops.segment_sum(
-                kept.astype(jnp.int32),
-                desired.astype(jnp.int32),
-                num_segments=num_labels,
-            ),
-            AXIS,
-        )
-        return (w > cap) & (arrivals > 0)
-
-    def cond(carry):
-        _, ow_fix = carry
-        return jnp.any(ow_fix)
-
-    def body(carry):
-        kept, ow_fix = carry
-        kept = kept & ~ow_fix[desired]
-        return kept, overweight_fixable(kept)
-
-    kept, _ = jax.lax.while_loop(cond, body, (commit, overweight_fixable(commit)))
-    final_labels = jnp.where(kept, desired, labels_loc)
-    num_moved = jax.lax.psum(jnp.sum(kept).astype(jnp.int32), AXIS)
-    return final_labels, num_moved
 
 
 @lru_cache(maxsize=None)
@@ -324,3 +339,201 @@ def shard_arrays(mesh: Mesh, graph, labels):
             recv_map=jax.device_put(graph.recv_map, s),
         ),
     )
+
+
+# ---------------------------------------------------------------------------
+# Colored supersteps (dist CLP).  Reference: clp_refiner.cc +
+# greedy_node_coloring.h — see refinement/clp_refiner.py for why color
+# classes make gains exact and tie moves safe.  Priorities are a
+# deterministic hash of the round and the node's *global* id, so both
+# endpoints of a cut edge agree on the winner without exchanging
+# priorities; only colors ride the ghost exchange.
+# ---------------------------------------------------------------------------
+
+
+def _hash_prio(round_i, gids):
+    """Deterministic 31-bit mix of (round, global id) — same value computed
+    on every shard that sees the node."""
+    x = gids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(round_i) * jnp.uint32(
+        0x85EBCA6B
+    )
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    return (x & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+
+
+def _color_round_body(
+    round_i, colors_loc, edge_u, col_loc, edge_w, send_idx, recv_map, n_loc: int
+):
+    """One Jones-Plassmann coloring round per shard (inside shard_map).
+    Only real edges (weight > 0) define adjacency — pad edges are inert —
+    and self-loops never rival their own node."""
+    from ..ops.coloring import _smallest_free, used_masks
+
+    idx = jax.lax.axis_index(AXIS)
+    gid_loc = idx * n_loc + jnp.arange(n_loc, dtype=jnp.int32)
+
+    ghost_colors = ghost_exchange(
+        colors_loc, send_idx, recv_map, fill=jnp.asarray(-1, colors_loc.dtype)
+    )
+    nbr_colors = _neighbor_labels(colors_loc, ghost_colors, col_loc, -1)
+    real = (edge_w > 0) & (col_loc != edge_u)
+    lo, hi = used_masks(jnp.where(real, nbr_colors, -1), edge_u, n_loc)
+    cand = _smallest_free(lo, hi)
+
+    # conflicts with uncolored real neighbors; deterministic hash priority
+    # of (round, global id) — identical on every shard, so no priority
+    # exchange is needed for local neighbors, and ghosts' values arrive via
+    # one exchange.  Equal-priority ties block both nodes for this round
+    # only (the hash changes per round), which preserves properness.
+    prio_loc = _hash_prio(round_i, gid_loc)
+    ghost_prio = ghost_exchange(
+        prio_loc, send_idx, recv_map, fill=jnp.asarray(-1, jnp.int32)
+    )
+    nbr_prio = _neighbor_labels(prio_loc, ghost_prio, col_loc, -1)
+    rival = jnp.where(real & (nbr_colors < 0), nbr_prio, -1)
+    best_rival = jax.ops.segment_max(rival, edge_u, num_segments=n_loc)
+    wins = prio_loc > best_rival
+    newly = (colors_loc < 0) & wins
+    return jnp.where(newly, cand, colors_loc)
+
+
+@lru_cache(maxsize=None)
+def make_dist_coloring(mesh: Mesh, *, max_rounds: int = 96):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=P(AXIS),
+    )
+    def color_fn(colors0, edge_u, col_loc, edge_w, send_idx, recv_map):
+        n_loc = colors0.shape[0]
+
+        def cond(carry):
+            i, colors = carry
+            any_left = jax.lax.psum(
+                jnp.sum((colors < 0).astype(jnp.int32)), AXIS
+            )
+            return (i < max_rounds) & (any_left > 0)
+
+        def body(carry):
+            i, colors = carry
+            colors = _color_round_body(
+                i, colors, edge_u, col_loc, edge_w, send_idx, recv_map, n_loc
+            )
+            return i + 1, colors
+
+        _, colors = jax.lax.while_loop(cond, body, (jnp.int32(0), colors0))
+        return jnp.maximum(colors, 0)
+
+    return jax.jit(color_fn)
+
+
+def dist_color(mesh: Mesh, graph) -> jax.Array:
+    """Color the sharded graph; returns (P*n_loc,) int32 colors."""
+    # Positional real-node mask (not weight-based: zero-weight real nodes
+    # must still be colored properly); pads take color 0 — they have no
+    # real edges, so any color is proper.
+    colors0 = jnp.where(
+        jnp.arange(graph.N) < graph.n, jnp.int32(-1), jnp.int32(0)
+    )
+    return make_dist_coloring(mesh)(
+        colors0, graph.edge_u, graph.col_loc, graph.edge_w,
+        graph.send_idx, graph.recv_map,
+    )
+
+
+def _colored_refine_round_body(
+    key, labels_loc, colors_loc, active_color, node_w_loc, edge_u, col_loc,
+    edge_w, max_w, send_idx, recv_map, *, num_labels: int,
+    allow_tie_moves: bool
+):
+    """A colored superstep: like _refine_round_body, but only the active
+    color class moves, gains are exact, and zero-gain moves are allowed
+    when configured — see refinement/clp_refiner.py."""
+    idx = jax.lax.axis_index(AXIS)
+    kshard = jax.random.fold_in(key, idx)
+    kr, kp, kt = jax.random.split(kshard, 3)
+    n_loc = labels_loc.shape[0]
+
+    ghost_labels = ghost_exchange(
+        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
+    )
+    cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
+
+    cluster_w = jax.lax.psum(
+        jax.ops.segment_sum(
+            node_w_loc, labels_loc.astype(jnp.int32), num_segments=num_labels
+        ),
+        AXIS,
+    )
+
+    target, tconn, own_conn, _ = flat_best_moves(
+        kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
+        cluster_w, max_w, num_rows=n_loc,
+        external_only=False, respect_caps=True,
+    )
+    better = tconn > own_conn
+    if allow_tie_moves:
+        coin = jax.random.bernoulli(kt, 0.5, tconn.shape)
+        better = better | ((tconn == own_conn) & coin)
+    desired = jnp.where(better, target, labels_loc)
+    mover = (desired != labels_loc) & (colors_loc == active_color)
+    return _probabilistic_commit(
+        kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels
+    )
+
+
+@lru_cache(maxsize=None)
+def make_dist_clp_round(mesh: Mesh, *, num_labels: int, allow_tie_moves: bool = True):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS), P(AXIS),
+                  P(AXIS), P(), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, labels, colors, active_color, node_w, edge_u, col_loc,
+                 edge_w, max_w, send_idx, recv_map):
+        return _colored_refine_round_body(
+            key, labels, colors, active_color, node_w, edge_u, col_loc,
+            edge_w, max_w, send_idx, recv_map, num_labels=num_labels,
+            allow_tie_moves=allow_tie_moves,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_clp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
+                     num_iterations: int = 2, allow_tie_moves: bool = True):
+    """Colored LP refinement: color once, then cycle the color classes
+    (reference: clp_refiner.cc supersteps).  Device-to-host syncs happen
+    once per iteration, not per superstep."""
+    import numpy as np
+
+    colors = dist_color(mesh, graph)
+    nc = int(np.asarray(colors).max()) + 1
+    fn = make_dist_clp_round(
+        mesh, num_labels=num_labels, allow_tie_moves=allow_tie_moves
+    )
+    total = 0
+    for it in range(num_iterations):
+        moved_iter = 0
+        for c in range(nc):
+            labels, moved = fn(
+                jax.random.fold_in(key, it * nc + c), labels, colors,
+                jnp.int32(c), graph.node_w, graph.edge_u, graph.col_loc,
+                graph.edge_w, max_w, graph.send_idx, graph.recv_map,
+            )
+            # The int() forces one dispatch at a time.  Queuing several
+            # collective-bearing shard_map programs concurrently can
+            # deadlock the CPU backend's cross-module rendezvous (observed:
+            # "Expected 8 threads to join, only 7 arrived"); per-call sync
+            # serializes them.  On real TPU streams serialize per device,
+            # but the sync stays for portability of the test path.
+            moved_iter += int(moved)
+        total += moved_iter
+        if moved_iter == 0:
+            break
+    return labels, total

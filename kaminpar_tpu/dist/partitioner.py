@@ -252,6 +252,38 @@ class DKaMinPar:
             num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
             external_only=False,
         )
+        from ..context import RefinementAlgorithm
+
+        if RefinementAlgorithm.CLP in self.ctx.refinement.algorithms:
+            from .lp import dist_clp_iterate
+
+            out, _ = dist_clp_iterate(
+                self.mesh, RandomState.next_key(), out, dgraph, cap,
+                num_labels=k,
+                num_iterations=self.ctx.refinement.clp.num_iterations,
+                allow_tie_moves=self.ctx.refinement.clp.allow_tie_moves,
+            )
+        if RefinementAlgorithm.JET in self.ctx.refinement.algorithms:
+            from .jet import dist_jet_iterate
+
+            jc = self.ctx.refinement.jet
+            # coarse levels = everything still carrying hierarchy below it
+            coarse = bool(self.hierarchy)
+            t0 = (
+                jc.initial_gain_temp_on_coarse_level
+                if coarse
+                else jc.initial_gain_temp_on_fine_level
+            )
+            t1 = (
+                jc.final_gain_temp_on_coarse_level
+                if coarse
+                else jc.final_gain_temp_on_fine_level
+            )
+            out, _ = dist_jet_iterate(
+                self.mesh, RandomState.next_key(), out, dgraph, cap,
+                num_labels=k, num_iterations=jc.num_iterations,
+                num_fruitless=jc.num_fruitless_iterations, temp0=t0, temp1=t1,
+            )
         return out
 
     def _replicate_to_host(self, dg: DistGraph) -> CSRGraph:

@@ -33,6 +33,10 @@ def create_refiner(ctx: Context, *, coarse_level: bool = False) -> Refiner:
             from .refinement.fm_refiner import FMRefiner
 
             refiners.append(FMRefiner(ctx.refinement.fm))
+        elif algo == RefinementAlgorithm.CLP:
+            from .refinement.clp_refiner import CLPRefiner
+
+            refiners.append(CLPRefiner(ctx.refinement.clp))
         elif algo == RefinementAlgorithm.JET:
             refiners.append(
                 JetRefiner(ctx.refinement.jet, ctx.refinement.balancer, coarse_level=coarse_level)

@@ -120,6 +120,7 @@ def _commit_moves(
     *,
     active_prob: float = 1.0,
     allow_tie_moves: bool = False,
+    active=None,
 ):
     """Synchronous (Jacobi) LP needs two oscillation guards the reference's
     asynchronous sweep gets for free (label_propagation.h processes nodes
@@ -148,6 +149,11 @@ def _commit_moves(
         better = better | ((tconn == own_conn) & coin)
     desired = jnp.where(better, target, labels)
     moved = desired != labels
+    if active is not None:
+        # Colored supersteps (CLP): only the given color class moves; the
+        # class is an independent set, so every gain is exact and tie
+        # moves cannot oscillate (no two movers are adjacent).
+        moved = moved & active
     if active_prob < 1.0:
         moved = moved & jax.random.bernoulli(ka, active_prob, moved.shape)
     accept = capacity_auction(
@@ -183,6 +189,35 @@ def lp_round_bucketed(
     return _commit_moves(
         state, kp, target, tconn, own_conn, node_w, max_label_weights, num_labels,
         active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_labels", "allow_tie_moves"))
+def lp_round_colored(
+    state: LPState,
+    key,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    active,
+    *,
+    num_labels: int,
+    allow_tie_moves: bool = True,
+) -> LPState:
+    """One colored superstep: only ``active`` (one color class = an
+    independent set) may move.  The CLP refiner's inner kernel (reference:
+    clp_refiner.cc supersteps)."""
+    kr, kp = jax.random.split(key)
+    target, tconn, own_conn, _ = bucketed_best_moves(
+        kr, state.labels, buckets, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=True,
+    )
+    return _commit_moves(
+        state, kp, target, tconn, own_conn, node_w, max_label_weights, num_labels,
+        allow_tie_moves=allow_tie_moves, active=active,
     )
 
 

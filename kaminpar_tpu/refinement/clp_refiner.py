@@ -1,0 +1,67 @@
+"""Colored LP refiner (CLP).
+
+Reference: ``kaminpar-dist/refinement/lp/clp_refiner.cc`` (961 LoC) +
+``algorithms/greedy_node_coloring.h:32`` — color the graph, then refine in
+*supersteps*: all nodes of one color class evaluate and execute their
+moves simultaneously.  A color class is an independent set, so
+
+- every computed gain is **exact** (no neighbor moves in the same step,
+  the Jacobi-LP staleness problem disappears), and
+- zero-gain diffusion moves are **oscillation-safe** (adjacent nodes are
+  never released together), restoring the asynchronous LP refiner's
+  boundary-straightening behavior that plain bulk-synchronous rounds
+  cannot have (see ops/lp.py:_commit_moves).
+
+This is the most TPU-friendly refiner shape in the reference tree
+(SURVEY §2.8-7): per superstep one masked LP round; balance via the same
+capacity auction (same-color movers can still target one block).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..context import ColoredLPContext
+from ..graph.partitioned import PartitionedGraph
+from ..ops import lp
+from ..ops.coloring import color_graph, num_colors
+from ..utils import next_key
+from ..utils.timer import scoped_timer
+from .refiner import Refiner
+
+
+class CLPRefiner(Refiner):
+    def __init__(self, ctx: ColoredLPContext):
+        self.ctx = ctx
+
+    def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
+        pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
+        k = p_graph.k
+        max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        part = pv.pad_node_array(p_graph.partition, 0)
+
+        with scoped_timer("clp_refinement"):
+            mask = jnp.arange(pv.n_pad) < pv.n
+            colors = color_graph(next_key(), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
+            nc = num_colors(colors, mask)
+
+            state = lp.init_state(part, pv.node_w, k)
+            before = p_graph.edge_cut()
+            for it in range(self.ctx.num_iterations):
+                moved = 0
+                for c in range(nc):
+                    state = lp.lp_round_colored(
+                        state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
+                        pv.node_w, max_w, colors == c, num_labels=k,
+                        allow_tie_moves=self.ctx.allow_tie_moves,
+                    )
+                    moved += int(state.num_moved)
+                if moved == 0:
+                    break
+            # Tie diffusion can wander; keep the better of (input, refined).
+            out = p_graph.with_partition(state.labels[: pv.n])
+            if out.edge_cut() > before:
+                return p_graph
+        return out

@@ -1,0 +1,66 @@
+"""Colored LP refiner tests (reference: clp_refiner.cc +
+greedy_node_coloring.h)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.context import ColoredLPContext
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.partitioned import PartitionedGraph
+from kaminpar_tpu.ops.coloring import color_graph, num_colors
+from kaminpar_tpu.refinement.clp_refiner import CLPRefiner
+
+
+def test_coloring_is_proper():
+    for g in (generators.grid2d_graph(16, 16), generators.rmat_graph(9, 8, seed=1)):
+        pv = g.padded()
+        mask = jnp.arange(pv.n_pad) < pv.n
+        colors = np.asarray(
+            color_graph(jax.random.PRNGKey(0), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
+        )
+        eu, cv, w = np.asarray(pv.edge_u), np.asarray(pv.col_idx), np.asarray(pv.edge_w)
+        real = (w > 0) & (eu != cv)
+        assert (colors[eu[real]] != colors[cv[real]]).all()
+
+
+def _pgraph(g, k, part, eps=0.1):
+    W = int(np.asarray(g.node_w).sum())
+    per = int(np.ceil(W / k) * (1 + eps)) + int(np.asarray(g.node_w).max())
+    return PartitionedGraph.create(g, k, part, np.full(k, per, dtype=np.int64))
+
+
+def test_clp_improves_noisy_grid():
+    g = generators.grid2d_graph(16, 16)
+    rng = np.random.default_rng(0)
+    part = (np.arange(256) // 64).astype(np.int32)
+    flip = rng.random(256) < 0.2
+    part[flip] = rng.integers(0, 4, flip.sum())
+    pg = _pgraph(g, 4, part)
+    out = CLPRefiner(ColoredLPContext()).refine(pg)
+    assert out.edge_cut() < pg.edge_cut()
+    assert out.is_feasible()
+
+
+def test_clp_straightens_boundaries_beyond_lp():
+    """Exact gains + safe tie diffusion should at least match strict LP."""
+    from kaminpar_tpu.context import LabelPropagationContext
+    from kaminpar_tpu.refinement.lp_refiner import LPRefiner
+
+    g = generators.rgg2d_graph(2048, seed=4)
+    rng = np.random.default_rng(4)
+    part = rng.integers(0, 8, g.n).astype(np.int32)
+    pg = _pgraph(g, 8, part)
+    lp_out = LPRefiner(LabelPropagationContext(num_iterations=8)).refine(pg)
+    clp_out = CLPRefiner(ColoredLPContext()).refine(lp_out)
+    assert clp_out.edge_cut() <= lp_out.edge_cut()
+    assert clp_out.is_feasible()
+
+
+def test_clp_never_worsens():
+    g = generators.rmat_graph(9, 8, seed=2)
+    rng = np.random.default_rng(2)
+    part = rng.integers(0, 4, g.n).astype(np.int32)
+    pg = _pgraph(g, 4, part)
+    out = CLPRefiner(ColoredLPContext()).refine(pg)
+    assert out.edge_cut() <= pg.edge_cut()

@@ -164,3 +164,63 @@ def test_per_shard_memory_stays_local():
         cap_q=cap_q,
     )
     assert int(moved) > 0
+
+
+def test_dist_coloring_is_proper():
+    """dist CLP prerequisite: the sharded Jones-Plassmann coloring must be
+    proper across shard boundaries (reference: greedy_node_coloring.h)."""
+    import numpy as np
+
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import dist_color, shard_arrays
+    from kaminpar_tpu.graph import generators
+
+    mesh = _mesh()
+    g = generators.rmat_graph(10, 8, seed=2)
+    dg = distribute_graph(g, mesh.size)
+    import jax.numpy as jnp
+
+    lab, dg = shard_arrays(mesh, dg, jnp.arange(dg.N, dtype=dg.dtype))
+    colors = np.asarray(dist_color(mesh, dg))
+    # reconstruct global edges and check properness
+    deg = np.diff(np.asarray(g.row_ptr))
+    u = np.repeat(np.arange(g.n), deg)
+    v = np.asarray(g.col_idx)
+    # map: global node id -> sharded slot id (n_loc per shard)
+    slot = np.arange(g.n) % dg.n_loc + (np.arange(g.n) // dg.n_loc) * dg.n_loc
+    cu, cv = colors[slot[u]], colors[slot[v]]
+    mask = u != v
+    assert (cu[mask] != cv[mask]).all(), int((cu[mask] == cv[mask]).sum())
+
+
+def test_dist_clp_refines():
+    import numpy as np
+
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import dist_clp_iterate, shard_arrays
+    from kaminpar_tpu.dist.metrics import dist_edge_cut
+    from kaminpar_tpu.graph import generators
+
+    mesh = _mesh()
+    g = generators.rgg2d_graph(1024, seed=5)
+    k = 4
+    rng = np.random.default_rng(5)
+    part = rng.integers(0, k, g.n).astype(np.int32)
+    dg = distribute_graph(g, mesh.size)
+    import jax.numpy as jnp
+
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[: g.n] = part
+    part_dev, dg = shard_arrays(mesh, dg, jnp.asarray(full))
+    W = int(np.asarray(g.node_w).sum())
+    cap = jnp.full(k, int(np.ceil(W / k) * 1.1) + 1, dtype=dg.dtype)
+    before = dist_edge_cut(mesh, part_dev, dg, k=k)
+    out, moved = dist_clp_iterate(
+        mesh, jax.random.PRNGKey(0), part_dev, dg, cap, num_labels=k
+    )
+    after = dist_edge_cut(mesh, out, dg, k=k)
+    assert after <= before, (after, before)
+    assert moved > 0
+    bw = np.bincount(np.asarray(out)[np.asarray(dg.node_w) > 0], minlength=k,
+                     weights=np.asarray(dg.node_w)[np.asarray(dg.node_w) > 0])
+    assert (bw <= np.asarray(cap)).all()
