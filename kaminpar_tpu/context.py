@@ -276,6 +276,15 @@ class ParallelContext:
 
 
 @dataclass
+class GraphCompressionContext:
+    """Reference: ``GraphCompressionContext`` (kaminpar.h) — whether the
+    input graph is stored compressed (graph/compressed.py, the TeraPart
+    analog)."""
+
+    enabled: bool = False
+
+
+@dataclass
 class DebugContext:
     """Reference: the debug dump options consumed by
     kaminpar-shm/partitioning/debug.cc."""
@@ -301,6 +310,9 @@ class Context:
     )
     refinement: RefinementContext = field(default_factory=RefinementContext)
     parallel: ParallelContext = field(default_factory=ParallelContext)
+    compression: GraphCompressionContext = field(
+        default_factory=GraphCompressionContext
+    )
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
     # v-cycle mode: intermediate k values partitioned before the final k

@@ -24,7 +24,7 @@ from ..ops.bucketed_gains import flat_best_moves, lookup
 from .balancer import dist_balance
 from .exchange import AXIS, ghost_exchange
 from .lp import _neighbor_labels
-from .metrics import dist_block_weights, dist_edge_cut
+from .metrics import dist_edge_cut
 
 
 def _jet_round_body(
@@ -126,16 +126,11 @@ def dist_jet_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
     infeasible one; among feasible ones, lower cut wins — so an infeasible
     seed can never shadow later feasible candidates."""
     fn = make_dist_jet_round(mesh, num_labels=num_labels)
-    cap = np.asarray(max_w)
 
-    def feasible(lab):
-        bw = dist_block_weights(mesh, lab, graph, k=num_labels)
-        return bool((bw <= cap).all())
-
-    labels, _ = dist_balance(mesh, key, labels, graph, max_w, k=num_labels)
+    labels, feas0 = dist_balance(mesh, key, labels, graph, max_w, k=num_labels)
     best = labels
     best_cut = dist_edge_cut(mesh, labels, graph, k=num_labels)
-    best_feasible = feasible(labels)
+    best_feasible = bool(feas0)
     locked = jnp.zeros(labels.shape, dtype=bool)
     fruitless = 0
     for it in range(num_iterations):
@@ -147,12 +142,12 @@ def dist_jet_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
             graph.send_idx, graph.recv_map, temp,
         )
         locked = moved
-        labels, _ = dist_balance(
+        labels, feas = dist_balance(
             mesh, jax.random.fold_in(key, 1000 + it), labels, graph, max_w,
             k=num_labels,
         )
+        feas = bool(feas)
         cut = dist_edge_cut(mesh, labels, graph, k=num_labels)
-        feas = feasible(labels)
         accept = (feas and not best_feasible) or (
             feas == best_feasible and cut <= best_cut
         )

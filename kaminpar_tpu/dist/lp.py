@@ -57,11 +57,13 @@ def _neighbor_labels(labels_loc, ghost_labels, col_loc, fill):
 
 
 def _probabilistic_commit(
-    kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels: int
+    kp, mover, desired, labels_loc, node_w_loc, max_w, cluster_w,
+    num_labels: int
 ):
     """Probabilistic capacity admission + overweight-rollback fixpoint
     (shared by the plain and colored refinement rounds; see
-    _refine_round_body for the semantics)."""
+    _refine_round_body for the semantics).  ``cluster_w`` is the callers'
+    already-reduced global block-weight table."""
 
     def global_weights(lab_loc):
         return jax.lax.psum(
@@ -71,7 +73,6 @@ def _probabilistic_commit(
             AXIS,
         )
 
-    cluster_w = global_weights(labels_loc)
     demand = jax.lax.psum(
         jax.ops.segment_sum(
             jnp.where(mover, node_w_loc, 0),
@@ -147,7 +148,7 @@ def _refine_round_body(
     desired = jnp.where(tconn > 0, target, labels_loc)
     mover = desired != labels_loc
     return _probabilistic_commit(
-        kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels
+        kp, mover, desired, labels_loc, node_w_loc, max_w, cluster_w, num_labels
     )
 
 
@@ -395,7 +396,8 @@ def _color_round_body(
     rival = jnp.where(real & (nbr_colors < 0), nbr_prio, -1)
     best_rival = jax.ops.segment_max(rival, edge_u, num_segments=n_loc)
     wins = prio_loc > best_rival
-    newly = (colors_loc < 0) & wins
+    # cand == 62 collides with the used-mask sentinel; stay uncolored
+    newly = (colors_loc < 0) & wins & (cand < 62)
     return jnp.where(newly, cand, colors_loc)
 
 
@@ -481,7 +483,7 @@ def _colored_refine_round_body(
     desired = jnp.where(better, target, labels_loc)
     mover = (desired != labels_loc) & (colors_loc == active_color)
     return _probabilistic_commit(
-        kp, mover, desired, labels_loc, node_w_loc, max_w, num_labels
+        kp, mover, desired, labels_loc, node_w_loc, max_w, cluster_w, num_labels
     )
 
 

@@ -78,7 +78,10 @@ class DKaMinPar:
         C = ctx.coarsening.contraction_limit
         target_n = max(2 * C, P * C // max(k, 1), 2 * k)
 
-        dg = distribute_graph(graph, P)
+        # 64-bit ids/weights mirror the reference's KAMINPAR_64BIT_* build
+        # switches (CMakeLists.txt:71-79); requires jax x64.
+        dtype = np.int64 if ctx.use_64bit_ids else np.int32
+        dg = distribute_graph(graph, P, dtype=dtype)
         labels = jnp.arange(dg.N, dtype=dg.dtype)
         labels, dg = shard_arrays(self.mesh, dg, labels)
 

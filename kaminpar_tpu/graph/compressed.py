@@ -1,0 +1,192 @@
+"""Compressed graph representation (TeraPart analog).
+
+Reference: ``kaminpar-common/graph_compression/`` (varint + interval
+encoded neighborhoods, ~2 941 LoC) and
+``kaminpar-shm/datastructures/compressed_graph.h:409`` — the memory tier
+that lets billion-edge graphs fit in RAM.
+
+The reference's byte-aligned varint streams are hostile to TPU decoding
+(data-dependent lengths serialize).  The TPU-native scheme keeps the same
+information-theoretic win — neighborhood *gaps* are small — but packs them
+at a **fixed bit width per node** chosen from the node's largest gap:
+
+- neighbors sorted ascending; first stored as a signed delta from the
+  node id (locality makes it small), the rest as consecutive gaps,
+- per-node width w(u) = bits(max zig-zag gap); all gaps of u packed
+  back-to-back into a shared uint32 word stream at word-aligned start,
+- decoding is one gather of (up to two) words + shifts/masks per edge —
+  fully vectorized, no data-dependent control flow, XLA/TPU friendly.
+
+Edge weights, when not all-1, are stored uncompressed (the reference does
+the same for its weighted streams).  ``decompress()`` reproduces the
+original CSRGraph bit-exactly (neighbors re-sorted ascending).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .csr import CSRGraph
+
+
+def _zigzag(x: np.ndarray) -> np.ndarray:
+    return (x << 1) ^ (x >> 63)
+
+
+def _unzigzag(z: np.ndarray) -> np.ndarray:
+    return (z >> 1) ^ -(z & 1)
+
+
+@dataclass
+class CompressedGraph:
+    n: int
+    m: int
+    words: np.ndarray  # uint32 packed gap stream
+    word_start: np.ndarray  # (n+1,) uint32 word offset per node
+    width: np.ndarray  # (n,) uint8 bits per gap
+    degree: np.ndarray  # (n,) node degrees
+    node_w: np.ndarray
+    edge_w: object  # None when all-1, else (m,) aligned with decompressed order
+
+    @property
+    def total_node_weight(self) -> int:
+        return int(self.node_w.sum())
+
+    def memory_bytes(self) -> int:
+        b = self.words.nbytes + self.word_start.nbytes + self.width.nbytes
+        b += self.degree.nbytes + self.node_w.nbytes
+        if self.edge_w is not None:
+            b += self.edge_w.nbytes
+        return b
+
+    def uncompressed_bytes(self) -> int:
+        """CSR(int32) footprint of the same graph."""
+        b = 4 * (self.n + 1) + 4 * self.m + 4 * self.n
+        if self.edge_w is not None:
+            b += 4 * self.m
+        return b
+
+    def compression_ratio(self) -> float:
+        return self.uncompressed_bytes() / max(self.memory_bytes(), 1)
+
+    # -- decoding ----------------------------------------------------------
+
+    def decompress(self) -> CSRGraph:
+        """Rebuild the CSRGraph (vectorized; the same arithmetic runs under
+        jit for on-device decoding)."""
+        deg = self.degree.astype(np.int64)
+        row_ptr = np.zeros(self.n + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        m = int(row_ptr[-1])
+        u_arr = np.repeat(np.arange(self.n), deg)
+        pos = np.arange(m) - row_ptr[u_arr]  # gap index within the node
+
+        w = self.width[u_arr].astype(np.int64)
+        bit = pos * w
+        word0 = self.word_start[u_arr].astype(np.int64) + (bit >> 5)
+        shift = bit & 31
+        lo = self.words[word0].astype(np.uint64)
+        hi = self.words[np.minimum(word0 + 1, len(self.words) - 1)].astype(np.uint64)
+        both = lo | (hi << np.uint64(32))
+        mask = (np.uint64(1) << w.astype(np.uint64)) - np.uint64(1)
+        z = (both >> shift.astype(np.uint64)) & mask
+        gaps = _unzigzag(z.astype(np.int64))
+
+        # first gap is relative to u; the rest accumulate
+        firsts = pos == 0
+        base = np.where(firsts, u_arr, 0)
+        vals = base + gaps
+        # segmented prefix sum: cumsum with reset at row starts
+        c = np.cumsum(vals)
+        seg_base = np.where(firsts, c - vals, 0)
+        run_base = np.maximum.accumulate(seg_base)
+        col = c - run_base
+
+        if m >= 2**31:
+            raise ValueError("edge count exceeds int32; use the 64-bit path")
+        return CSRGraph(
+            row_ptr.astype(np.int32),
+            col.astype(np.int32),
+            self.node_w,
+            None if self.edge_w is None else self.edge_w,
+        )
+
+
+def compress(graph) -> CompressedGraph:
+    """Compress a CSRGraph (host numpy; one sort + vectorized packing)."""
+    row_ptr = np.asarray(graph.row_ptr).astype(np.int64)
+    col = np.asarray(graph.col_idx).astype(np.int64)
+    n = graph.n
+    deg = np.diff(row_ptr)
+    u_arr = np.repeat(np.arange(n), deg)
+    ew = np.asarray(graph.edge_w)
+
+    # sort each neighborhood ascending (stable by (u, v)), keeping weights
+    order = np.lexsort((col, u_arr))
+    col = col[order]
+    ew = ew[order]
+    if bool((ew == 1).all()):
+        ew_out = None
+    else:
+        if int(ew.max(initial=0)) >= 2**31:
+            raise ValueError("edge weight exceeds int32; use the 64-bit path")
+        ew_out = ew.astype(np.int32)
+
+    # gaps: first neighbor relative to u (zig-zag for the sign), then
+    # consecutive differences (non-negative, zig-zag is cheap anyway)
+    firsts = np.zeros(len(col), dtype=bool)
+    firsts[row_ptr[:-1][deg > 0]] = True
+    prev = np.concatenate([[0], col[:-1]])
+    gaps = np.where(firsts, col - u_arr, col - prev)
+    z = _zigzag(gaps)
+
+    # per-node width = bits of the largest zig-zag gap (min 1)
+    width = np.ones(n, dtype=np.int64)
+    if len(z):
+        zmax = np.zeros(n, dtype=np.int64)
+        np.maximum.at(zmax, u_arr, z)
+        width = np.maximum(
+            np.ceil(np.log2(np.maximum(zmax, 1) + 1)).astype(np.int64), 1
+        )
+    if int(width.max(initial=1)) > 32:
+        raise ValueError(
+            "neighborhood gap exceeds 32 bits (node ids >= 2^31); the "
+            "compressed representation is 32-bit — partition with the "
+            "uncompressed 64-bit path instead"
+        )
+
+    bits_per_node = width * deg
+    words_per_node = (bits_per_node + 31) // 32
+    word_start = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(words_per_node, out=word_start[1:])
+    total_words = int(word_start[-1]) + 1  # +1 sentinel for straddle reads
+
+    # scatter-pack: each gap contributes to one or two words
+    w_e = width[u_arr]
+    pos = np.arange(len(z)) - row_ptr[u_arr]
+    bit = pos * w_e
+    word0 = word_start[u_arr] + (bit >> 5)
+    shift = bit & 31
+    lo_part = (z << shift) & 0xFFFFFFFF
+    hi_part = z >> np.maximum(32 - shift, 0)
+    # hi_part only valid when the value straddles (shift + w > 32)
+    straddle = shift + w_e > 32
+    words = np.zeros(total_words, dtype=np.uint64)
+    np.bitwise_or.at(words, word0, lo_part.astype(np.uint64))
+    if straddle.any():
+        np.bitwise_or.at(
+            words, word0[straddle] + 1, hi_part[straddle].astype(np.uint64)
+        )
+
+    return CompressedGraph(
+        n=n,
+        m=int(deg.sum()),
+        words=words.astype(np.uint32),
+        word_start=word_start.astype(np.uint32),
+        width=width.astype(np.uint8),
+        degree=deg.astype(np.int32),
+        node_w=np.asarray(graph.node_w).astype(np.int32),
+        edge_w=ew_out,
+    )

@@ -39,11 +39,35 @@ class KaMinPar:
             ctx = create_context_by_preset_name(ctx)
         self.ctx = ctx
         self.graph: Optional[CSRGraph] = None
+        self.compressed_graph: Optional[object] = None
         self._last: Optional[PartitionedGraph] = None
 
     # -- graph input -------------------------------------------------------
 
-    def set_graph(self, graph: CSRGraph) -> None:
+    def set_graph(self, graph) -> None:
+        """Accepts a CSRGraph or a CompressedGraph (reference: the facade's
+        Graph variant over CSR/compressed, kaminpar.h).  With
+        ``ctx.compression.enabled`` (terapart presets) a CSR input is
+        stored compressed and decoded on demand — the storage tier of the
+        TeraPart analog; kernel-level on-the-fly decoding is a documented
+        future step (graph/compressed.py)."""
+        from .graph.compressed import CompressedGraph, compress
+
+        if isinstance(graph, CompressedGraph):
+            self.compressed_graph: Optional[object] = graph
+            graph = None
+        elif self.ctx.compression.enabled:
+            self.compressed_graph = compress(graph)
+            Logger.log(
+                f"compressed input: {self.compressed_graph.memory_bytes()} B "
+                f"({self.compressed_graph.compression_ratio():.2f}x)",
+            )
+            # Steady-state memory = the compressed copy only; the CSR form
+            # exists transiently inside compute_partition (kernel-level
+            # on-the-fly decoding is the next step, HBM_BUDGET.md).
+            graph = None
+        else:
+            self.compressed_graph = None
         self.graph = graph
 
     def copy_graph(
@@ -80,8 +104,14 @@ class KaMinPar:
         underload balancer) via ``min_epsilon`` (reference:
         ``set_uniform_min_block_weights``) or absolute ``min_block_weights``.
         """
-        assert self.graph is not None, "call set_graph/copy_graph first"
-        graph = self.graph
+        assert (
+            self.graph is not None or self.compressed_graph is not None
+        ), "call set_graph/copy_graph first"
+        graph = (
+            self.graph
+            if self.graph is not None
+            else self.compressed_graph.decompress()
+        )
         ctx = self.ctx
         if k <= 0:
             raise ValueError("k must be positive")

@@ -93,7 +93,10 @@ def color_graph(key, edge_u, col_idx, node_mask, *, n: int, max_rounds: int = 64
         )
         me = jnp.arange(n, dtype=col_idx.dtype)
         wins = (prio > best_rival) | ((prio == best_rival) & (me > tie_rival))
-        newly = (colors < 0) & wins
+        # cand == MAX_COLORS would collide with the sentinel in used_masks
+        # (neighbors would see it as "no color") — leave such nodes
+        # uncolored; they retry as neighbors' colors settle.
+        newly = (colors < 0) & wins & (cand < MAX_COLORS)
         colors = jnp.where(newly, cand, colors)
         return i + 1, colors
 

@@ -141,6 +141,30 @@ def create_largek_strong_context() -> Context:
     return ctx
 
 
+def create_terapart_context() -> Context:
+    """Reference: ``create_terapart_context`` (presets.cc "terapart") —
+    the memory-efficient tier: default pipeline over a compressed input
+    graph (graph/compressed.py)."""
+    ctx = create_default_context()
+    ctx.preset_name = "terapart"
+    ctx.compression.enabled = True
+    return ctx
+
+
+def create_terapart_eco_context() -> Context:
+    ctx = create_eco_context()
+    ctx.preset_name = "terapart-eco"
+    ctx.compression.enabled = True
+    return ctx
+
+
+def create_terapart_largek_context() -> Context:
+    ctx = _apply_largek_delta(create_default_context())
+    ctx.preset_name = "terapart-largek"
+    ctx.compression.enabled = True
+    return ctx
+
+
 def create_vcycle_context(restricted: bool = False) -> Context:
     """Reference: ``create_vcycle_context(restricted)`` (presets.cc
     "vcycle"/"restricted-vcycle"): deep multilevel driven through
@@ -188,6 +212,9 @@ _PRESETS = {
     "largek-fast": create_largek_fast_context,
     "largek-eco": create_largek_eco_context,
     "largek-strong": create_largek_strong_context,
+    "terapart": create_terapart_context,
+    "terapart-eco": create_terapart_eco_context,
+    "terapart-largek": create_terapart_largek_context,
     # esa21-* (the original ESA'21 deep multilevel configurations) map onto
     # the deep-scheme presets above — rename-only aliases like "fm"/"flow".
     "esa21-smallk": create_default_context,

@@ -1,0 +1,90 @@
+"""Compressed graph tests (reference: graph_compression/ +
+compressed_graph.h round-trip semantics)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.compressed import CompressedGraph, compress
+
+
+def _sorted_csr(g):
+    rp = np.asarray(g.row_ptr).astype(np.int64)
+    col = np.asarray(g.col_idx).astype(np.int64)
+    ew = np.asarray(g.edge_w)
+    u = np.repeat(np.arange(g.n), np.diff(rp))
+    order = np.lexsort((col, u))
+    return rp, col[order], ew[order]
+
+
+@pytest.mark.parametrize("gen", [
+    lambda: generators.grid2d_graph(32, 32),
+    lambda: generators.rmat_graph(10, 8, seed=1),
+    lambda: generators.rgg2d_graph(2048, seed=2),
+    lambda: generators.star_graph(50),
+    lambda: generators.path_graph(1),
+])
+def test_roundtrip_exact(gen):
+    g = gen()
+    cg = compress(g)
+    out = cg.decompress()
+    rp, col, ew = _sorted_csr(g)
+    np.testing.assert_array_equal(np.asarray(out.row_ptr).astype(np.int64), rp)
+    np.testing.assert_array_equal(np.asarray(out.col_idx).astype(np.int64), col)
+    np.testing.assert_array_equal(np.asarray(out.edge_w), ew)
+    np.testing.assert_array_equal(np.asarray(out.node_w), np.asarray(g.node_w))
+
+
+def test_roundtrip_weighted():
+    rng = np.random.default_rng(0)
+    g = generators.rgg2d_graph(1024, seed=3,
+                               node_weights=rng.integers(1, 9, 1024))
+    # give edges weights by symmetrized random
+    from kaminpar_tpu.graph.csr import from_edge_list
+
+    rp = np.asarray(g.row_ptr); col = np.asarray(g.col_idx)
+    u = np.repeat(np.arange(g.n), np.diff(rp))
+    key = np.minimum(u, col) * g.n + np.maximum(u, col)
+    w = (key % 7 + 1).astype(np.int64)
+    g2 = from_edge_list(g.n, np.stack([u, col], 1), edge_weights=w,
+                        node_weights=np.asarray(g.node_w),
+                        symmetrize=False, dedup=False)
+    cg = compress(g2)
+    out = cg.decompress()
+    rp2, col2, ew2 = _sorted_csr(g2)
+    np.testing.assert_array_equal(np.asarray(out.col_idx).astype(np.int64), col2)
+    np.testing.assert_array_equal(np.asarray(out.edge_w), ew2)
+
+
+def test_compression_ratio_on_local_graphs():
+    """Geometric/mesh graphs have small gaps -> real compression."""
+    g = generators.grid2d_graph(64, 64)
+    cg = compress(g)
+    assert cg.compression_ratio() > 1.3, cg.compression_ratio()
+    g = generators.rgg2d_graph(4096, seed=1)
+    cg = compress(g)
+    assert cg.compression_ratio() > 2.0, cg.compression_ratio()
+
+
+def test_terapart_preset_end_to_end():
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    g = generators.rgg2d_graph(1024, seed=4)
+    s = KaMinPar("terapart")
+    s.set_graph(g)
+    assert s.compressed_graph is not None
+    part = s.compute_partition(k=4)
+    assert metrics.is_feasible(g, part, 4, s.ctx.partition.max_block_weights)
+
+
+def test_facade_accepts_compressed_graph():
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    g = generators.rgg2d_graph(1024, seed=5)
+    cg = compress(g)
+    s = KaMinPar("default")
+    s.set_graph(cg)
+    part = s.compute_partition(k=4)
+    assert metrics.is_feasible(g, part, 4, s.ctx.partition.max_block_weights)

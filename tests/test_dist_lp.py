@@ -182,13 +182,12 @@ def test_dist_coloring_is_proper():
 
     lab, dg = shard_arrays(mesh, dg, jnp.arange(dg.N, dtype=dg.dtype))
     colors = np.asarray(dist_color(mesh, dg))
-    # reconstruct global edges and check properness
+    # reconstruct global edges and check properness (in the contiguous
+    # block layout, global id == flat sharded slot id)
     deg = np.diff(np.asarray(g.row_ptr))
     u = np.repeat(np.arange(g.n), deg)
     v = np.asarray(g.col_idx)
-    # map: global node id -> sharded slot id (n_loc per shard)
-    slot = np.arange(g.n) % dg.n_loc + (np.arange(g.n) // dg.n_loc) * dg.n_loc
-    cu, cv = colors[slot[u]], colors[slot[v]]
+    cu, cv = colors[u], colors[v]
     mask = u != v
     assert (cu[mask] != cv[mask]).all(), int((cu[mask] == cv[mask]).sum())
 
