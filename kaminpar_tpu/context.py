@@ -146,6 +146,10 @@ class InitialPartitioningContext:
     # Shrink factor below which IP coarsening is considered converged
     # (reference: InitialCoarseningContext::convergence_threshold = 0.05).
     coarsening_convergence_threshold: float = 0.05
+    # Extension splits into >= 4 parts on subgraphs at least this large use
+    # a nested (device) deep pipeline instead of chained host bisections —
+    # measured stronger on dense geometric graphs (extend_partition).
+    nested_extension_n: int = 4096
     # Up to this finest-graph size, also run the flat pool on the finest
     # graph and keep the better of {mini-ML, flat} — measured divergence
     # from the reference (which always uses ML): on expander-like coarse

@@ -396,8 +396,10 @@ def _color_round_body(
     rival = jnp.where(real & (nbr_colors < 0), nbr_prio, -1)
     best_rival = jax.ops.segment_max(rival, edge_u, num_segments=n_loc)
     wins = prio_loc > best_rival
-    # cand == 62 collides with the used-mask sentinel; stay uncolored
-    newly = (colors_loc < 0) & wins & (cand < 62)
+    from ..ops.coloring import MAX_COLORS
+
+    # cand == MAX_COLORS collides with the used-mask sentinel; stay uncolored
+    newly = (colors_loc < 0) & wins & (cand < MAX_COLORS)
     return jnp.where(newly, cand, colors_loc)
 
 

@@ -79,7 +79,14 @@ class DKaMinPar:
         target_n = max(2 * C, P * C // max(k, 1), 2 * k)
 
         # 64-bit ids/weights mirror the reference's KAMINPAR_64BIT_* build
-        # switches (CMakeLists.txt:71-79); requires jax x64.
+        # switches (CMakeLists.txt:71-79); requires jax x64 (without it the
+        # device arrays silently downcast to int32 — exactly the workloads
+        # this flag exists for would be corrupted).
+        if ctx.use_64bit_ids and not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "use_64bit_ids requires jax x64 mode "
+                "(jax.config.update('jax_enable_x64', True))"
+            )
         dtype = np.int64 if ctx.use_64bit_ids else np.int32
         dg = distribute_graph(graph, P, dtype=dtype)
         labels = jnp.arange(dg.N, dtype=dg.dtype)

@@ -32,33 +32,41 @@ class HostChunk:
     edge_w: np.ndarray
 
 
-def _scan_line_offsets(path: str, chunk_bytes: int = 1 << 24) -> np.ndarray:
-    """Byte offset of each line start (streaming, O(1) memory per chunk)."""
-    offsets = [0]
+def _scan_boundary_offsets(
+    path: str, wanted_lines: list, chunk_bytes: int = 1 << 24
+) -> dict:
+    """Byte offsets of the given line numbers (streaming; O(len(wanted))
+    memory — the full per-line offset table of a billion-edge file would
+    be GBs on its own)."""
+    wanted = np.asarray(sorted(set(wanted_lines)), dtype=np.int64)
+    out = {0: 0} if 0 in wanted else {}
+    line = 0
     pos = 0
     with open(path, "rb") as f:
         while True:
             buf = f.read(chunk_bytes)
             if not buf:
                 break
-            nl = np.frombuffer(buf, dtype=np.uint8) == ord("\n")
-            offsets.append(np.flatnonzero(nl).astype(np.int64) + pos + 1)
+            nl_pos = np.flatnonzero(np.frombuffer(buf, dtype=np.uint8) == ord("\n"))
+            # line i+1 starts after the i-th newline overall
+            starts = nl_pos.astype(np.int64) + pos + 1
+            lines = line + 1 + np.arange(len(nl_pos), dtype=np.int64)
+            hit = np.isin(lines, wanted)
+            for ln, st in zip(lines[hit], starts[hit]):
+                out[int(ln)] = int(st)
+            line += len(nl_pos)
             pos += len(buf)
-    flat = [np.asarray([0], dtype=np.int64)] + offsets[1:]
-    return np.concatenate(flat)
+    return out
 
 
 def read_metis_chunked(
     path: str, num_shards: int
 ) -> Iterator[Tuple[int, Tuple[int, int], HostChunk]]:
     """Yield each shard's node range parsed from only its byte slice."""
-    line_off = _scan_line_offsets(path)
-
     # parse the header (first non-comment line)
     with open(path, "rb") as f:
         header_line = 0
         while True:
-            f.seek(line_off[header_line])
             raw = f.readline()
             if raw.strip() and not raw.lstrip().startswith(b"%"):
                 break
@@ -71,19 +79,23 @@ def read_metis_chunked(
 
     # node i lives on line header_line + 1 + i (comments between body lines
     # are not supported by the chunked parser — the reference's chunked
-    # parsers have the same restriction)
+    # parsers have the same restriction; a '%' in a body slice raises below)
     n_loc = -(n // -num_shards)
+    boundary_lines = []
+    for s in range(num_shards):
+        lo = min(s * n_loc, n)
+        hi = min(lo + n_loc, n)
+        boundary_lines.append(header_line + 1 + lo)
+        boundary_lines.append(header_line + 1 + hi)
+    line_off = _scan_boundary_offsets(path, boundary_lines)
+
     for s in range(num_shards):
         lo = min(s * n_loc, n)
         hi = min(lo + n_loc, n)
         first_line = header_line + 1 + lo
         last_line = header_line + 1 + hi  # exclusive
-        start = int(line_off[first_line]) if first_line < len(line_off) else None
-        end = (
-            int(line_off[last_line])
-            if last_line < len(line_off)
-            else None
-        )
+        start = line_off.get(first_line)
+        end = line_off.get(last_line)
         if lo == hi or start is None:
             yield s, (lo, hi), HostChunk(
                 lo, hi, np.zeros(hi - lo + 1, dtype=np.int64),
@@ -94,6 +106,12 @@ def read_metis_chunked(
         with open(path, "rb") as f:
             f.seek(start)
             data = f.read((end - start) if end is not None else -1)
+        if b"%" in data:
+            raise ValueError(
+                "comment lines inside the METIS body are not supported by "
+                "the chunked parser (they would shift node attribution); "
+                "use io.metis.read_metis"
+            )
         values, line = _tokenize(data)
         # lines within the slice map to nodes lo..hi-1
         node_of_token = line if values.size else np.zeros(0, dtype=np.int64)

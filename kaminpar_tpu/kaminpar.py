@@ -81,8 +81,11 @@ class KaMinPar:
         kaminpar.cc:179-218)."""
         from .graph.csr import from_numpy_csr
 
-        self.graph = from_numpy_csr(
-            row_ptr, col_idx, node_weights, edge_weights, use_64bit=self.ctx.use_64bit_ids
+        self.set_graph(
+            from_numpy_csr(
+                row_ptr, col_idx, node_weights, edge_weights,
+                use_64bit=self.ctx.use_64bit_ids,
+            )
         )
 
     # -- partitioning ------------------------------------------------------

@@ -23,6 +23,7 @@ from ..utils import RandomState
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .kway import graph_to_host
+from ..context import PartitioningMode
 from .partition_utils import compute_k_for_n, intermediate_block_weights, split_offsets
 
 
@@ -58,9 +59,40 @@ def extend_partition(
             [final_bw[off_new[j] : off_new[j + 1]].sum() for j in range(lo, hi)],
             dtype=np.int64,
         )
-        subpart = recursive_bipartition(sub, sub_k, budgets, rng, ctx.initial_partitioning)
+        if sub_k >= 4 and sub.n >= ctx.initial_partitioning.nested_extension_n:
+            # Large multi-way splits: the full (device) deep pipeline beats
+            # the host mini-ML bisection chain — measured at or below the
+            # reference's cut at this size (BASELINE_measured.md), while
+            # chained 2-way splits compound a few % loss per level.
+            subpart = _nested_partition(sub, sub_k, budgets, ctx)
+        else:
+            subpart = recursive_bipartition(
+                sub, sub_k, budgets, rng, ctx.initial_partitioning
+            )
         out[nodes] = subpart + lo
     return out
+
+
+def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.ndarray:
+    """Partition one extension subgraph with a nested deep pipeline.
+
+    Constructs the partitioner directly (not through the KaMinPar facade,
+    which reseeds the global RNG and resets the timer tree — side effects
+    the enclosing pipeline must not see)."""
+    import copy
+
+    from ..graph.csr import from_numpy_csr
+
+    sub_ctx = copy.deepcopy(ctx)
+    sub_ctx.mode = PartitioningMode.DEEP
+    sub_ctx.compression.enabled = False
+    sub_ctx.partition.k = sub_k
+    sub_ctx.partition.max_block_weights = np.asarray(budgets, dtype=np.int64)
+    sub_ctx.partition.min_block_weights = None
+    sub_ctx.partition.total_node_weight = int(sub.node_w.sum())
+    g = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
+    p = DeepMultilevelPartitioner(sub_ctx, g).partition()
+    return np.asarray(p.partition).astype(np.int32)
 
 
 class DeepMultilevelPartitioner:
