@@ -115,6 +115,11 @@ def _init_backend() -> tuple[str, str | None]:
 def main() -> None:
     backend, backend_err = _init_backend()
     on_tpu = backend not in ("cpu", "cpu-fallback")
+    if not on_tpu:
+        # CPU path: the persistent-cache executable serializer is the known
+        # crasher (see kaminpar_tpu/__init__); a benchmark must never die
+        # writing a cache.
+        jax.config.update("jax_compilation_cache_dir", None)
     default_scale = 22 if on_tpu else 16
     scale = int(os.environ.get("KPTPU_BENCH_SCALE", default_scale))
     rounds = int(os.environ.get("KPTPU_BENCH_ROUNDS", 5))

@@ -14,7 +14,12 @@ import jax as _jax
 # Persistent XLA compilation cache: multilevel runs hit a bounded set of
 # power-of-2 kernel shapes (see graph/csr.py PaddedView); caching them on disk
 # makes every run after the first start hot.  Override dir or disable via env.
-if _os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") != "1":
+# DISABLED by default on the CPU backend: jaxlib's executable serializer
+# intermittently crashes (SIGSEGV/SIGABRT) inside put_executable_and_time
+# there; tests force it off (tests/conftest.py), and a JAX_PLATFORMS=cpu
+# environment defaults it off too.
+_default_no_cache = "1" if _os.environ.get("JAX_PLATFORMS", "") == "cpu" else "0"
+if _os.environ.get("KAMINPAR_TPU_NO_CACHE", _default_no_cache) != "1":
     _cache_dir = _os.environ.get(
         "KAMINPAR_TPU_CACHE_DIR",
         _os.path.join(_os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla"),
@@ -24,6 +29,13 @@ if _os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") != "1":
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # Do NOT let the persistent cache serialize XLA:CPU AOT executables:
+        # jaxlib's serializer intermittently SIGSEGV/SIGABRTs inside
+        # put_executable_and_time on this backend (observed crashing the
+        # test suite from two different kernels), and cross-machine AOT
+        # artifacts also reload with machine-feature mismatches.  Caching
+        # the HLO/compilation only keeps most of the warm-start benefit.
+        _jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
     except Exception:  # pragma: no cover — cache is an optimization only
         pass
 

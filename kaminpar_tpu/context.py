@@ -207,11 +207,22 @@ class FMContext:
     max_n: int = 1 << 17
 
 
+class MoveExecutionStrategy(enum.Enum):
+    """Distributed LP move commitment (reference:
+    LabelPropagationMoveExecutionStrategy, dkaminpar.h:116-120; LOCAL_MOVES
+    has no analog — bulk-synchronous rounds have no PE-local view to apply
+    eagerly)."""
+
+    PROBABILISTIC = "probabilistic"
+    BEST_MOVES = "best-moves"
+
+
 @dataclass
 class RefinementContext:
     """Pipeline of refiners, run in order on every uncoarsening level
     (reference: MultiRefiner, factories.cc:97-147)."""
 
+    dist_move_execution: MoveExecutionStrategy = MoveExecutionStrategy.PROBABILISTIC
     algorithms: tuple = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,

@@ -41,6 +41,17 @@ from ..utils.intmath import next_pow2
 from .exchange import AXIS, ghost_exchange, owner_aggregate, pack_by_owner
 
 
+def _global_block_weights(node_w_loc, labels_loc, num_labels: int):
+    """psum'd (num_labels,) block-weight table — the replicated table every
+    refinement round keeps (distributed_partitioned_graph.h:15)."""
+    return jax.lax.psum(
+        jax.ops.segment_sum(
+            node_w_loc, labels_loc.astype(jnp.int32), num_segments=num_labels
+        ),
+        AXIS,
+    )
+
+
 def _neighbor_labels(labels_loc, ghost_labels, col_loc, fill):
     """Per-edge candidate labels from the local + ghost label table."""
     ext = jnp.concatenate(
@@ -64,15 +75,6 @@ def _probabilistic_commit(
     (shared by the plain and colored refinement rounds; see
     _refine_round_body for the semantics).  ``cluster_w`` is the callers'
     already-reduced global block-weight table."""
-
-    def global_weights(lab_loc):
-        return jax.lax.psum(
-            jax.ops.segment_sum(
-                node_w_loc, lab_loc.astype(jnp.int32), num_segments=num_labels
-            ),
-            AXIS,
-        )
-
     demand = jax.lax.psum(
         jax.ops.segment_sum(
             jnp.where(mover, node_w_loc, 0),
@@ -85,11 +87,21 @@ def _probabilistic_commit(
     p_accept = jnp.where(demand > 0, remaining / jnp.maximum(demand, 1), 0.0)
     u = jax.random.uniform(kp, mover.shape)
     commit = mover & (u < jnp.clip(p_accept[desired], 0.0, 1.0))
+    return _overweight_rollback(
+        commit, desired, labels_loc, node_w_loc, max_w, num_labels
+    )
 
+
+def _overweight_rollback(commit, desired, labels_loc, node_w_loc, max_w,
+                         num_labels: int):
+    """Reject in-moves of blocks that ended overweight until a fixpoint
+    (shared by every dist commit strategy; see _probabilistic_commit)."""
     cap = lookup(max_w, jnp.arange(num_labels))
 
     def overweight_fixable(kept):
-        w = global_weights(jnp.where(kept, desired, labels_loc))
+        w = _global_block_weights(
+            node_w_loc, jnp.where(kept, desired, labels_loc), num_labels
+        )
         arrivals = jax.lax.psum(
             jax.ops.segment_sum(
                 kept.astype(jnp.int32),
@@ -130,15 +142,7 @@ def _refine_round_body(
     )
     cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
 
-    def global_weights(lab_loc):
-        return jax.lax.psum(
-            jax.ops.segment_sum(
-                node_w_loc, lab_loc.astype(jnp.int32), num_segments=num_labels
-            ),
-            AXIS,
-        )
-
-    cluster_w = global_weights(labels_loc)
+    cluster_w = _global_block_weights(node_w_loc, labels_loc, num_labels)
 
     target, tconn, _, _ = flat_best_moves(
         kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
@@ -466,12 +470,7 @@ def _colored_refine_round_body(
     )
     cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
 
-    cluster_w = jax.lax.psum(
-        jax.ops.segment_sum(
-            node_w_loc, labels_loc.astype(jnp.int32), num_segments=num_labels
-        ),
-        AXIS,
-    )
+    cluster_w = _global_block_weights(node_w_loc, labels_loc, num_labels)
 
     target, tconn, own_conn, _ = flat_best_moves(
         kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
@@ -541,3 +540,126 @@ def dist_clp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
         if moved_iter == 0:
             break
     return labels, total
+
+
+# ---------------------------------------------------------------------------
+# BEST_MOVES commit strategy.  Reference:
+# LabelPropagationMoveExecutionStrategy::BEST_MOVES (dkaminpar.h:116-120):
+# instead of admitting movers probabilistically, collect the globally best
+# moves per block (the reference reduces candidate lists through a binary
+# reduction tree, binary_reduction_tree.h:18).  The TPU redesign replaces
+# the tree with a psum'd per-(block, gain-bucket) weight histogram: every
+# shard learns how much mover weight each block attracts at each gain
+# level, derives the per-block admission threshold locally, and keeps only
+# movers above it — one collective, no tree, no candidate shipping.
+# ---------------------------------------------------------------------------
+
+_GAIN_BUCKETS = 32
+
+
+def _best_moves_commit(
+    kp, mover, desired, gain, labels_loc, node_w_loc, max_w, cluster_w,
+    num_labels: int
+):
+    """Admit the globally best movers per block by gain-histogram threshold."""
+    # Quantize gains into buckets; bucket 0 = best (the histogram is
+    # scanned from the best bucket down).
+    # movers all have gain >= 1 (desired only diverges on positive gain),
+    # so the bucket span is simply [0, gmax]
+    gmax = jnp.maximum(jax.lax.pmax(jnp.max(jnp.where(mover, gain, -(2**30))), AXIS), 1)
+    span = gmax
+    bucket = jnp.clip(
+        ((gmax - gain) * (_GAIN_BUCKETS - 1)) // span, 0, _GAIN_BUCKETS - 1
+    ).astype(jnp.int32)
+
+    flat = desired.astype(jnp.int32) * _GAIN_BUCKETS + bucket
+    hist = jax.lax.psum(
+        jax.ops.segment_sum(
+            jnp.where(mover, node_w_loc, 0), flat,
+            num_segments=num_labels * _GAIN_BUCKETS,
+        ),
+        AXIS,
+    ).reshape(num_labels, _GAIN_BUCKETS)
+
+    remaining = jnp.maximum(
+        lookup(max_w, jnp.arange(num_labels)) - cluster_w, 0
+    )
+    cum = jnp.cumsum(hist, axis=1)
+    # admit buckets whose cumulative weight still fits; the first partially
+    # fitting bucket is admitted probabilistically by the leftover fraction
+    fits = cum <= remaining[:, None]
+    thresh = jnp.sum(fits.astype(jnp.int32), axis=1)  # buckets fully admitted
+    prev_cum = jnp.concatenate(
+        [jnp.zeros((num_labels, 1), cum.dtype), cum[:, :-1]], axis=1
+    )
+    partial_room = jnp.maximum(remaining[:, None] - prev_cum, 0)
+    frac = jnp.where(
+        hist > 0, partial_room / jnp.maximum(hist, 1), 0.0
+    )
+
+    full_ok = bucket < thresh[desired]
+    at_partial = bucket == thresh[desired]
+    u = jax.random.uniform(kp, mover.shape)
+    partial_ok = at_partial & (
+        u < jnp.clip(frac[desired, jnp.clip(bucket, 0, _GAIN_BUCKETS - 1)], 0.0, 1.0)
+    )
+    kept = mover & (full_ok | partial_ok)
+    # the partial bucket admits probabilistically and can overshoot; the
+    # shared rollback fixpoint guarantees caps
+    return _overweight_rollback(
+        kept, desired, labels_loc, node_w_loc, max_w, num_labels
+    )
+
+
+def _best_refine_round_body(
+    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
+    recv_map, *, num_labels: int
+):
+    idx = jax.lax.axis_index(AXIS)
+    kshard = jax.random.fold_in(key, idx)
+    kr, kp = jax.random.split(kshard)
+    n_loc = labels_loc.shape[0]
+
+    ghost_labels = ghost_exchange(
+        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
+    )
+    cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
+    cluster_w = _global_block_weights(node_w_loc, labels_loc, num_labels)
+    target, tconn, own_conn, _ = flat_best_moves(
+        kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
+        cluster_w, max_w, num_rows=n_loc,
+        external_only=False, respect_caps=True,
+    )
+    gain = tconn - own_conn
+    desired = jnp.where(gain > 0, target, labels_loc)
+    mover = desired != labels_loc
+    return _best_moves_commit(
+        kp, mover, desired, gain, labels_loc, node_w_loc, max_w, cluster_w,
+        num_labels,
+    )
+
+
+@lru_cache(maxsize=None)
+def make_dist_lp_round_best(mesh: Mesh, *, num_labels: int):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
+                  P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+                 send_idx, recv_map):
+        return _best_refine_round_body(
+            key, labels, node_w, edge_u, col_loc, edge_w, max_w,
+            send_idx, recv_map, num_labels=num_labels,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_lp_round_best(mesh, key, labels, graph, max_w, *, num_labels: int):
+    """One BEST_MOVES refinement round."""
+    fn = make_dist_lp_round_best(mesh, num_labels=num_labels)
+    return fn(key, labels, graph.node_w, graph.edge_u, graph.col_loc,
+              graph.edge_w, max_w, graph.send_idx, graph.recv_map)

@@ -257,12 +257,28 @@ class DKaMinPar:
                 "feasibility; the returned partition may exceed block caps",
                 OutputLevel.WARNING,
             )
-        out, _ = dist_lp_iterate(
-            self.mesh, RandomState.next_key(), part, dgraph, cap,
-            num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
-            external_only=False,
-        )
-        from ..context import RefinementAlgorithm
+        from ..context import MoveExecutionStrategy, RefinementAlgorithm
+
+        if (
+            self.ctx.refinement.dist_move_execution
+            == MoveExecutionStrategy.BEST_MOVES
+        ):
+            from .lp import dist_lp_round_best
+
+            out = part
+            for _ in range(self.ctx.refinement.lp.num_iterations):
+                out, moved = dist_lp_round_best(
+                    self.mesh, RandomState.next_key(), out, dgraph, cap,
+                    num_labels=k,
+                )
+                if int(moved) == 0:
+                    break
+        else:
+            out, _ = dist_lp_iterate(
+                self.mesh, RandomState.next_key(), part, dgraph, cap,
+                num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
+                external_only=False,
+            )
 
         if RefinementAlgorithm.CLP in self.ctx.refinement.algorithms:
             from .lp import dist_clp_iterate

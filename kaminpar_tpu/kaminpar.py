@@ -144,8 +144,71 @@ class KaMinPar:
             )
             return np.zeros(0, dtype=np.int32)
 
-        partitioner = create_partitioner(ctx, graph)
+        # Strip isolated nodes before partitioning and bin-pack them into
+        # the lightest blocks afterwards (reference: kaminpar.cc:388-429 —
+        # isolated nodes never affect the cut, but they dilute coarsening
+        # and refinement; RMAT-family graphs are full of them).
+        rp = np.asarray(graph.row_ptr)
+        deg = rp[1:] - rp[:-1]
+        isolated = np.flatnonzero(deg == 0)
+        work_graph = graph
+        keep = None
+        if 0 < len(isolated) < graph.n and k <= graph.n - len(isolated):
+            keep = np.flatnonzero(deg > 0)
+            from .graph.csr import from_numpy_csr
+
+            remap = np.full(graph.n, -1, dtype=np.int64)
+            remap[keep] = np.arange(len(keep))
+            new_rp = np.zeros(len(keep) + 1, dtype=np.int64)
+            np.cumsum(deg[keep], out=new_rp[1:])
+            work_graph = from_numpy_csr(
+                new_rp,
+                remap[np.asarray(graph.col_idx)],
+                np.asarray(graph.node_w)[keep],
+                np.asarray(graph.edge_w),
+                use_64bit=ctx.use_64bit_ids,
+            )
+            Logger.log(f"Removed {len(isolated)} isolated nodes")
+
+        partitioner = create_partitioner(ctx, work_graph)
         p_graph = partitioner.partition()
+
+        if keep is not None:
+            # Re-integrate: greedy lightest-block assignment respecting the
+            # caps (reference: graph::assign_isolated_nodes).  A k-entry
+            # heap keeps this O(n_iso log k) — RMAT graphs can have
+            # millions of isolated nodes.
+            import heapq
+
+            sub_part = np.asarray(p_graph.partition)
+            full_part = np.zeros(graph.n, dtype=sub_part.dtype)
+            full_part[keep] = sub_part
+            bw = np.bincount(
+                sub_part, weights=np.asarray(work_graph.node_w), minlength=k
+            ).astype(np.int64)
+            caps = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
+            iso_w = np.asarray(graph.node_w)[isolated]
+            order = np.argsort(-iso_w)  # heaviest first packs tightest
+            heap = [(int(bw[b]), b) for b in range(k)]
+            heapq.heapify(heap)
+            for u, w in zip(isolated[order], iso_w[order]):
+                w = int(w)
+                popped = []
+                while heap and heap[0][0] + w > caps[heap[0][1]]:
+                    popped.append(heapq.heappop(heap))
+                if heap:
+                    wt, b = heapq.heappop(heap)
+                else:  # nothing fits: overload the lightest block
+                    popped.sort()
+                    wt, b = popped.pop(0)
+                full_part[u] = b
+                heapq.heappush(heap, (wt + w, b))
+                for item in popped:
+                    heapq.heappush(heap, item)
+            p_graph = PartitionedGraph.create(
+                graph, k, full_part,
+                ctx.partition.max_block_weights, ctx.partition.min_block_weights,
+            )
         self._last = p_graph
 
         part = np.asarray(p_graph.partition)

@@ -11,6 +11,12 @@ backends initialize lazily.
 import os
 import sys
 
+# The persistent XLA cache must stay off under the CPU backend: jaxlib's
+# executable serializer intermittently SIGSEGV/SIGABRTs in
+# put_executable_and_time (kaminpar_tpu/__init__.py note).  Must be set
+# before kaminpar_tpu is first imported.
+os.environ.setdefault("KAMINPAR_TPU_NO_CACHE", "1")
+
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _repo_root not in sys.path:
     sys.path.insert(0, _repo_root)

@@ -223,3 +223,35 @@ def test_dist_clp_refines():
     bw = np.bincount(np.asarray(out)[np.asarray(dg.node_w) > 0], minlength=k,
                      weights=np.asarray(dg.node_w)[np.asarray(dg.node_w) > 0])
     assert (bw <= np.asarray(cap)).all()
+
+
+def test_dist_best_moves_round():
+    """BEST_MOVES strategy (dkaminpar.h:116-120): globally best movers per
+    block, never exceeding caps."""
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import dist_lp_round_best, shard_arrays
+    from kaminpar_tpu.dist.metrics import dist_block_weights, dist_edge_cut
+    from kaminpar_tpu.graph import generators
+
+    mesh = _mesh()
+    g = generators.rgg2d_graph(1024, seed=13)
+    k = 4
+    rng = np.random.default_rng(13)
+    part = rng.integers(0, k, g.n).astype(np.int32)
+    dg = distribute_graph(g, mesh.size)
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[: g.n] = part
+    part_dev, dg = shard_arrays(mesh, dg, jnp.asarray(full))
+    W = int(np.asarray(g.node_w).sum())
+    cap = jnp.full(k, int(np.ceil(W / k) * 1.1) + 1, dtype=dg.dtype)
+    before = dist_edge_cut(mesh, part_dev, dg, k=k)
+    bw0 = dist_block_weights(mesh, part_dev, dg, k=k)
+    assert (bw0 <= np.asarray(cap)).all()
+    out, moved = dist_lp_round_best(
+        mesh, jax.random.PRNGKey(2), part_dev, dg, cap, num_labels=k
+    )
+    after = dist_edge_cut(mesh, out, dg, k=k)
+    assert int(moved) > 0
+    assert after < before, (after, before)
+    bw = dist_block_weights(mesh, out, dg, k=k)
+    assert (bw <= np.asarray(cap)).all(), bw

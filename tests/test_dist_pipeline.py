@@ -205,3 +205,53 @@ def test_dist_pipeline_int64():
         bw = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
         assert (bw <= per).all()
         assert len(np.unique(part)) == k
+
+
+def test_dist_validate_partition():
+    """Reference: dist debug.cc:122 validate_partition analog."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaminpar_tpu.dist.debug import validate_partition
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import shard_arrays
+    from kaminpar_tpu.graph import generators
+
+    mesh = _mesh()
+    g = generators.rgg2d_graph(1024, seed=14)
+    k = 4
+    rng = np.random.default_rng(14)
+    part = rng.integers(0, k, g.n).astype(np.int32)
+    dg = distribute_graph(g, mesh.size)
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[: g.n] = part
+    part_dev, dg = shard_arrays(mesh, dg, jnp.asarray(full))
+    ok, problems = validate_partition(mesh, part_dev, dg, k)
+    assert ok, problems
+
+    # an out-of-range label must be caught
+    bad = np.array(full)
+    bad[0] = k + 3
+    part_bad, dg = shard_arrays(mesh, dg, jnp.asarray(bad))
+    ok, problems = validate_partition(mesh, part_bad, dg, k)
+    assert not ok and any("range" in p for p in problems), problems
+
+
+def test_dist_pipeline_best_moves_strategy():
+    import numpy as np
+
+    from kaminpar_tpu.context import MoveExecutionStrategy
+    from kaminpar_tpu.dist.partitioner import DKaMinPar
+    from kaminpar_tpu.graph import generators
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    ctx.refinement.dist_move_execution = MoveExecutionStrategy.BEST_MOVES
+    ctx.coarsening.contraction_limit = 128
+    g = generators.rgg2d_graph(1024, seed=15)
+    k = 4
+    part = DKaMinPar(_mesh(), ctx).compute_partition(g, k=k, epsilon=0.05)
+    W = g.total_node_weight
+    per = int(np.ceil(W / k) * 1.05) + int(np.asarray(g.node_w).max())
+    bw = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
+    assert (bw <= per).all()

@@ -102,3 +102,30 @@ def test_strong_not_worse_than_fast():
         part = solver.compute_partition(k=4)
         cuts[preset] = metrics.edge_cut(g, part)
     assert cuts["strong"] <= cuts["fast"] * 1.1
+
+
+def test_isolated_nodes_stripped_and_reintegrated():
+    """Reference: kaminpar.cc:388-429 — isolated nodes are removed before
+    partitioning and bin-packed into the lightest blocks afterwards."""
+    import numpy as np
+
+    from kaminpar_tpu.graph import generators, metrics
+    from kaminpar_tpu.graph.csr import from_numpy_csr
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    base = generators.rgg2d_graph(512, seed=12)
+    # append 128 isolated nodes with varied weights
+    rp = np.asarray(base.row_ptr)
+    n_iso = 128
+    rng = np.random.default_rng(0)
+    rp2 = np.concatenate([rp, np.full(n_iso, rp[-1])])
+    nw = np.concatenate([np.asarray(base.node_w), rng.integers(1, 5, n_iso)])
+    g = from_numpy_csr(rp2, np.asarray(base.col_idx), nw, np.asarray(base.edge_w))
+    k = 4
+    s = KaMinPar("default")
+    s.set_graph(g)
+    part = s.compute_partition(k=k)
+    assert len(part) == g.n
+    assert metrics.is_feasible(g, part, k, s.ctx.partition.max_block_weights)
+    # all isolated nodes got assigned to real blocks
+    assert set(np.unique(part[512:])) <= set(range(k))
