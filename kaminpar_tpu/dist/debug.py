@@ -14,8 +14,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from functools import lru_cache, partial
+
+from jax.sharding import PartitionSpec as P
+
 from .exchange import ghost_exchange
 from .metrics import dist_block_weights
+
+
+@lru_cache(maxsize=None)
+def _make_ghost_reader(mesh: Mesh):
+    """Jitted ghost-label reader, cached per mesh (same pattern as the
+    make_dist_* round factories — a fresh closure per call would recompile
+    every phase-boundary validation)."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("nodes"), P("nodes"), P("nodes")),
+        out_specs=P("nodes"),
+    )
+    def ghosts(lab_loc, send_idx, recv_map):
+        return ghost_exchange(
+            lab_loc, send_idx, recv_map, fill=jnp.asarray(-1, lab_loc.dtype)
+        )
+
+    return jax.jit(ghosts)
 
 
 def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None):
@@ -40,21 +63,9 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
             )
 
     # ghost consistency through the actual exchange program
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    @partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P("nodes"), P("nodes"), P("nodes")),
-        out_specs=P("nodes"),
+    gl = np.asarray(
+        _make_ghost_reader(mesh)(labels, graph.send_idx, graph.recv_map)
     )
-    def ghosts(lab_loc, send_idx, recv_map):
-        return ghost_exchange(
-            lab_loc, send_idx, recv_map, fill=jnp.asarray(-1, lab_loc.dtype)
-        )
-
-    gl = np.asarray(jax.jit(ghosts)(labels, graph.send_idx, graph.recv_map))
     gl = gl.reshape(graph.num_shards, graph.g_loc)
     for s in range(graph.num_shards):
         gg = graph.ghost_global[s]

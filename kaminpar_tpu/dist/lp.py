@@ -567,9 +567,8 @@ def _best_moves_commit(
     # movers all have gain >= 1 (desired only diverges on positive gain),
     # so the bucket span is simply [0, gmax]
     gmax = jnp.maximum(jax.lax.pmax(jnp.max(jnp.where(mover, gain, -(2**30))), AXIS), 1)
-    span = gmax
     bucket = jnp.clip(
-        ((gmax - gain) * (_GAIN_BUCKETS - 1)) // span, 0, _GAIN_BUCKETS - 1
+        ((gmax - gain) * (_GAIN_BUCKETS - 1)) // gmax, 0, _GAIN_BUCKETS - 1
     ).astype(jnp.int32)
 
     flat = desired.astype(jnp.int32) * _GAIN_BUCKETS + bucket

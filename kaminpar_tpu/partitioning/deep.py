@@ -91,8 +91,16 @@ def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.
     sub_ctx.partition.min_block_weights = None
     sub_ctx.partition.total_node_weight = int(sub.node_w.sum())
     g = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
-    p = DeepMultilevelPartitioner(sub_ctx, g).partition()
-    return np.asarray(p.partition).astype(np.int32)
+    # Independent attempts, best cut wins (>=1 enforced): extension
+    # mistakes are unrecoverable downstream — the same reason the reference
+    # repeats its initial bipartitioner (initial_pool_bipartitioner.cc).
+    best_part, best_cut = None, None
+    for _ in range(max(ctx.initial_partitioning.nested_extension_reps, 1)):
+        p = DeepMultilevelPartitioner(sub_ctx, g).partition()
+        cut = p.edge_cut()
+        if best_cut is None or cut < best_cut:
+            best_part, best_cut = np.asarray(p.partition).astype(np.int32), cut
+    return best_part
 
 
 class DeepMultilevelPartitioner:
