@@ -91,15 +91,19 @@ def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.
     sub_ctx.partition.min_block_weights = None
     sub_ctx.partition.total_node_weight = int(sub.node_w.sum())
     g = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
-    # Independent attempts, best cut wins (>=1 enforced): extension
+    # Independent attempts, best (feasible-first, then cut) wins: extension
     # mistakes are unrecoverable downstream — the same reason the reference
     # repeats its initial bipartitioner (initial_pool_bipartitioner.cc).
-    best_part, best_cut = None, None
-    for _ in range(max(ctx.initial_partitioning.nested_extension_reps, 1)):
+    reps = max(ctx.initial_partitioning.nested_extension_reps, 1)
+    if reps == 1:
         p = DeepMultilevelPartitioner(sub_ctx, g).partition()
-        cut = p.edge_cut()
-        if best_cut is None or cut < best_cut:
-            best_part, best_cut = np.asarray(p.partition).astype(np.int32), cut
+        return np.asarray(p.partition).astype(np.int32)
+    best_part, best_score = None, None
+    for _ in range(reps):
+        p = DeepMultilevelPartitioner(sub_ctx, g).partition()
+        score = (not p.is_feasible(), p.edge_cut())
+        if best_score is None or score < best_score:
+            best_part, best_score = np.asarray(p.partition).astype(np.int32), score
     return best_part
 
 

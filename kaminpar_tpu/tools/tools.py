@@ -112,9 +112,29 @@ def rearrange(argv) -> int:
     return 0
 
 
+def compression(argv) -> int:
+    """Reference: GraphCompressionTool.cc — report the compressed footprint
+    of a graph (graph/compressed.py, the TeraPart analog)."""
+    p = argparse.ArgumentParser(prog="compression")
+    p.add_argument("graph")
+    args = p.parse_args(argv)
+    from ..graph.compressed import compress
+
+    g = _read(args.graph)
+    cg = compress(g)
+    print(f"Graph: {args.graph}")
+    print(f"  n: {cg.n}  m: {cg.m // 2} (undirected)")
+    print(f"  uncompressed (CSR int32): {cg.uncompressed_bytes()} B")
+    print(f"  compressed:               {cg.memory_bytes()} B")
+    print(f"  ratio:                    {cg.compression_ratio():.2f}x")
+    print(f"  mean gap width:           {float(cg.width.mean()):.1f} bits")
+    return 0
+
+
 REGISTRY = {
     "graph-properties": graph_properties,
     "partition-properties": partition_properties,
     "connected-components": connected_components,
     "rearrange": rearrange,
+    "compression": compression,
 }

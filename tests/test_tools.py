@@ -89,3 +89,9 @@ def test_debug_dumps(tmp_path):
     dumps = list(tmp_path.iterdir())
     assert any(p.suffix == ".metis" for p in dumps), dumps
     assert any(p.suffix == ".part" for p in dumps), dumps
+
+
+def test_compression_tool():
+    out = _run_tool("compression", "/root/reference/misc/rgg2d.metis")
+    assert out.returncode == 0, out.stderr
+    assert "ratio:" in out.stdout
