@@ -12,6 +12,7 @@ power-of-2 bucket serves every hierarchy level of that size.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..context import LabelPropagationContext
@@ -21,13 +22,41 @@ from ..utils import next_key
 from ..utils.timer import scoped_timer
 
 
+@jax.jit
+def _intersect_clusterings(la, lb):
+    """Overlay intersection: u, v share a cluster iff they share one in BOTH
+    inputs (reference: overlay_cluster_coarsener.cc).  Labels stay node ids
+    (the LP convention): each (la, lb) run is relabeled to its smallest
+    member."""
+    n = la.shape[0]
+    order = jnp.lexsort((lb, la))
+    from ..ops.segment import run_starts2
+
+    first = run_starts2(la[order], lb[order])
+    rid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    rep = jax.ops.segment_min(order.astype(la.dtype), rid, num_segments=n)
+    return jnp.zeros_like(la).at[order].set(rep[rid])
+
+
 class LPClustering:
-    def __init__(self, ctx: LabelPropagationContext):
+    def __init__(self, ctx: LabelPropagationContext, overlay_levels: int = 1):
         self.ctx = ctx
+        self.overlay_levels = max(int(overlay_levels), 1)
 
     def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
         """Returns padded labels (over graph.padded()); pad nodes carry the
         anchor label."""
+        with scoped_timer("lp_clustering"):
+            labels = self._one_clustering(graph, max_cluster_weight)
+            # Overlay: intersect independent clusterings (rounder clusters;
+            # randomized-run variance cancels).  Intersection only splits
+            # clusters, so the weight cap stays respected.
+            for _ in range(self.overlay_levels - 1):
+                other = self._one_clustering(graph, max_cluster_weight)
+                labels = _intersect_clusterings(labels, other)
+        return labels
+
+    def _one_clustering(self, graph: CSRGraph, max_cluster_weight: int):
         pv = graph.padded()
         bv = graph.bucketed()
         n_pad = pv.n_pad
@@ -43,8 +72,27 @@ class LPClustering:
         # uniform and a scalar saves one m-sized gather per round
         max_w = jnp.asarray(int(max_cluster_weight), dtype=idt)
 
-        with scoped_timer("lp_clustering"):
-            state = lp.lp_iterate_bucketed(
+        state = lp.lp_iterate_bucketed(
+            state,
+            next_key(),
+            bv.buckets,
+            bv.heavy,
+            bv.gather_idx,
+            pv.node_w,
+            max_w,
+            jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+            num_labels=n_pad,
+            max_iterations=self.ctx.num_iterations,
+            active_prob=self.ctx.active_prob,
+            tie_break=self.ctx.tie_breaking.value,
+        )
+
+        if self.ctx.cluster_isolated_nodes:
+            state = lp.cluster_isolated_nodes(
+                state, pv.row_ptr, pv.node_w, max_w, num_labels=n_pad
+            )
+        if self.ctx.cluster_two_hop_nodes:
+            state = lp.cluster_two_hop_nodes_bucketed(
                 state,
                 next_key(),
                 bv.buckets,
@@ -52,25 +100,6 @@ class LPClustering:
                 bv.gather_idx,
                 pv.node_w,
                 max_w,
-                jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
                 num_labels=n_pad,
-                max_iterations=self.ctx.num_iterations,
-                active_prob=self.ctx.active_prob,
             )
-
-            if self.ctx.cluster_isolated_nodes:
-                state = lp.cluster_isolated_nodes(
-                    state, pv.row_ptr, pv.node_w, max_w, num_labels=n_pad
-                )
-            if self.ctx.cluster_two_hop_nodes:
-                state = lp.cluster_two_hop_nodes_bucketed(
-                    state,
-                    next_key(),
-                    bv.buckets,
-                    bv.heavy,
-                    bv.gather_idx,
-                    pv.node_w,
-                    max_w,
-                    num_labels=n_pad,
-                )
         return state.labels

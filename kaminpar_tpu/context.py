@@ -52,10 +52,17 @@ class InitialPartitioningMode(enum.Enum):
 
 
 class TieBreakingStrategy(enum.Enum):
-    """LP tie-breaking (reference: ``TieBreakingStrategy``, kaminpar.h)."""
+    """LP tie-breaking (reference: ``TieBreakingStrategy``, kaminpar.h).
+
+    LIGHTEST is TPU-specific: among equally-rated clusters prefer the one
+    with the lowest current weight (then random).  On unweighted geometric
+    graphs integer ratings tie constantly and uniform tie-breaking lets a
+    few clusters snowball; biasing toward the lighter cluster grows
+    rounder, evenly-sized clusters (the size-constrained-LP idea)."""
 
     UNIFORM = "uniform"
     GEOMETRIC = "geometric"
+    LIGHTEST = "lightest"
 
 
 class ClusterWeightLimit(enum.Enum):
@@ -118,6 +125,12 @@ class CoarseningContext:
     convergence_threshold: float = 0.05
     cluster_weight_limit: ClusterWeightLimit = ClusterWeightLimit.EPSILON_BLOCK_WEIGHT
     cluster_weight_multiplier: float = 1.0
+    # Overlay clustering (reference: overlay_cluster_coarsener.cc, ESA'25):
+    # intersect this many independent LP clusterings; two nodes share an
+    # overlay cluster only if every run agrees.  Slower shrink per level,
+    # rounder clusters (variance of any single randomized run cancels).
+    # <= 1 disables.
+    overlay_levels: int = 1
 
 
 @dataclass
@@ -208,8 +221,10 @@ class FMContext:
     abortion_threshold: float = 0.999
     # TPU divergence: FM runs as a sequential host pass on small levels only;
     # JET is the at-scale device refiner (see fm_refiner.py module docstring).
-    # Cost scales with border size, not n (measured ~1s at n=65k, k=64).
-    max_n: int = 1 << 17
+    # The vectorized dense-connection-matrix pass (round 3) costs O(moves*k)
+    # plus an O(n*k) matrix; both gates below bound that memory/time.
+    max_n: int = 1 << 20
+    max_nk: int = 1 << 26  # dense (n, k) connection-matrix entry budget
 
 
 class MoveExecutionStrategy(enum.Enum):
@@ -228,6 +243,10 @@ class RefinementContext:
     (reference: MultiRefiner, factories.cc:97-147)."""
 
     dist_move_execution: MoveExecutionStrategy = MoveExecutionStrategy.PROBABILISTIC
+    # Sub-rounds over disjoint hash-chunks of the nodes per dist LP round
+    # (reference: dist lp_refiner.cc processes 8 chunks per round to bound
+    # move staleness; commits happen between chunks).
+    dist_num_chunks: int = 8
     algorithms: tuple = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,

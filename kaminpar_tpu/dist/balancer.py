@@ -158,6 +158,201 @@ def _balance_round_body(
     return new_labels, moved, still
 
 
+def _cluster_balance_round_body(
+    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_bw, send_idx,
+    recv_map, *, k: int, grow_rounds: int = 3
+):
+    """One cluster-balance round (the node balancer's stuck escalation).
+
+    Reference: ``cluster_balancer.cc`` (1 075 LoC) + ``clusters.cc`` (627):
+    grow weight-bounded clusters from nodes of overloaded blocks (the
+    reference builds them PE-locally too), rate each cluster's best target
+    block, and move whole clusters.  Where the node balancer commits
+    probabilistically (and can thrash when receivers only have room for
+    specific weight combinations — its dry-round stuck case), this phase is
+    deterministic-greedy like the reference's *sequential* rounds
+    (ClusterBalancer::Statistics::num_seq_rounds): per overloaded block,
+    the single best-relative-gain fitting cluster moves per round, so every
+    round makes progress or proves none is possible.
+
+    Shard-local clusters, global block weights via psum; the receiver-side
+    rollback fixpoint is shared with the node round.
+    """
+    idx = jax.lax.axis_index(AXIS)
+    kshard = jax.random.fold_in(key, idx)
+    kg, kc = jax.random.split(kshard)
+    n_loc = labels_loc.shape[0]
+    real = node_w_loc > 0
+
+    block_w = jax.lax.psum(
+        jax.ops.segment_sum(
+            node_w_loc, labels_loc.astype(jnp.int32), num_segments=k
+        ),
+        AXIS,
+    )
+    overload = jnp.maximum(block_w - max_bw, 0)
+    over_b = overload > 0
+    remaining = jnp.maximum(max_bw - block_w, 0)
+    in_over = over_b[labels_loc] & real
+
+    # -- grow clusters among same-block local nodes of overloaded blocks --
+    # Weight cap: a cluster must fit the roomiest receiver and should not
+    # overshoot its own block's overload (clusters.cc bounds growth by the
+    # per-block overload as well).
+    cap = jnp.maximum(
+        jnp.minimum(jnp.max(remaining), jnp.max(jnp.where(over_b, overload, 0))),
+        1,
+    ).astype(node_w_loc.dtype)
+    local_nbr = col_loc < n_loc
+    src_block = labels_loc[edge_u]
+    nbr_local = jnp.clip(col_loc, 0, n_loc - 1)
+    same_block = local_nbr & (labels_loc[nbr_local] == src_block)
+    grow_w = jnp.where(same_block & in_over[edge_u], edge_w, 0)
+
+    clabels = jnp.arange(n_loc, dtype=labels_loc.dtype)
+    for g in range(grow_rounds):
+        cw = jax.ops.segment_sum(node_w_loc, clabels, num_segments=n_loc)
+        cand_cl = clabels[nbr_local]
+        target_cl, tconn, _, has = flat_best_moves(
+            jax.random.fold_in(kg, g), edge_u, cand_cl, grow_w, clabels,
+            node_w_loc, cw, cap, num_rows=n_loc,
+            external_only=True, respect_caps=True,
+        )
+        # Only singleton clusters join (LP-style adoption); the auction
+        # keeps merged weights under the cap even for simultaneous joiners.
+        from ..ops.lp import capacity_auction
+
+        singleton = cw[clabels] == node_w_loc
+        mover = in_over & has & singleton & (target_cl != clabels)
+        accept = capacity_auction(
+            jax.random.fold_in(kg, 100 + g), mover, target_cl, node_w_loc,
+            cw, cap, n_loc,
+        )
+        clabels = jnp.where(mover & accept, target_cl, clabels)
+
+    # -- rate clusters: best external block by connection ------------------
+    cw = jax.ops.segment_sum(node_w_loc, clabels, num_segments=n_loc)
+    cl_block = jax.ops.segment_max(
+        jnp.where(real, labels_loc, 0), clabels, num_segments=n_loc
+    ).astype(labels_loc.dtype)
+    ghost_labels = ghost_exchange(
+        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
+    )
+    nbr_block = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
+    ext_w = jnp.where(in_over[edge_u], edge_w, 0)  # rated edges only
+    row_cl = clabels[edge_u]
+    target, tconn, _own, has = flat_best_moves(
+        kc, row_cl, nbr_block, ext_w, cl_block, cw, block_w, max_bw,
+        num_rows=n_loc, external_only=True, respect_caps=True,
+    )
+    # Fallback mirror of the node round: clusters with no *adjacent*
+    # feasible target go to the roomiest block that fits them (interior
+    # clusters of a deeply overloaded block have no external edges at all).
+    roomiest = jnp.argmax(remaining).astype(target.dtype)
+    fb_ok = (~has) & (cw <= remaining[roomiest]) & (roomiest != cl_block)
+    target = jnp.where(fb_ok, roomiest, target)
+    tconn = jnp.where(fb_ok, 0, tconn)
+    has = has | fb_ok
+
+    # -- deterministic greedy: best cluster per overloaded block ----------
+    # relative gain = conn / weight (clusters.h relative_gain).  Selection
+    # uses a globally UNIQUE sortable key — float32 rel in the high bits
+    # (non-negative floats bit-cast to int32 are order-preserving), global
+    # cluster id in the low 31 bits — so exactly one cluster wins per
+    # source block and per receiver across all shards; equal-gain ties
+    # cannot make two shards dump on the same receiver and bounce off the
+    # all-or-nothing rollback (every round makes deterministic progress).
+    is_cluster = (cw > 0) & over_b[cl_block] & has
+    rel = tconn.astype(jnp.float32) / jnp.maximum(cw, 1).astype(jnp.float32)
+    # int64 is unavailable without jax x64, so the (rel, gid) lexicographic
+    # max runs as two chained int32 reductions.
+    rel_bits = jax.lax.bitcast_convert_type(rel, jnp.int32)
+    gid = idx * n_loc + jnp.arange(n_loc, dtype=jnp.int32)
+
+    def _lex_best(mask, seg):
+        segi = seg.astype(jnp.int32)
+        b1 = jax.lax.pmax(
+            jax.ops.segment_max(
+                jnp.where(mask, rel_bits, jnp.int32(-1)), segi, num_segments=k
+            ),
+            AXIS,
+        )
+        m2 = mask & (rel_bits == b1[segi])
+        b2 = jax.lax.pmax(
+            jax.ops.segment_max(
+                jnp.where(m2, gid, jnp.int32(-1)), segi, num_segments=k
+            ),
+            AXIS,
+        )
+        return m2 & (gid == b2[segi])
+
+    chosen = _lex_best(is_cluster, cl_block)
+    # One arrival per *receiver* as well: each chosen cluster was verified
+    # to fit the receiver's current weight, so a single arrival can never
+    # trip the rollback fixpoint.
+    chosen = _lex_best(chosen, target)
+
+    # -- receiver-side rollback fixpoint at cluster granularity -----------
+    def overweight_fixable(kept):
+        move_w = jnp.where(kept, cw, 0)
+        arrivals = jax.lax.psum(
+            jax.ops.segment_sum(
+                move_w, target.astype(jnp.int32), num_segments=k
+            ),
+            AXIS,
+        )
+        w = block_w + arrivals - jax.lax.psum(
+            jax.ops.segment_sum(
+                move_w, cl_block.astype(jnp.int32), num_segments=k
+            ),
+            AXIS,
+        )
+        return (w > max_bw) & (arrivals > 0)
+
+    def cond(carry):
+        _, ow = carry
+        return jnp.any(ow)
+
+    def body(carry):
+        kept, ow = carry
+        kept = kept & ~ow[target]
+        return kept, overweight_fixable(kept)
+
+    kept, _ = jax.lax.while_loop(
+        cond, body, (chosen, overweight_fixable(chosen))
+    )
+    move_cl = kept[clabels]
+    new_labels = jnp.where(move_cl, target[clabels], labels_loc)
+    new_bw = jax.lax.psum(
+        jax.ops.segment_sum(
+            node_w_loc, new_labels.astype(jnp.int32), num_segments=k
+        ),
+        AXIS,
+    )
+    moved = jax.lax.psum(jnp.sum(move_cl & real).astype(jnp.int32), AXIS)
+    still = jnp.any(new_bw > max_bw)
+    return new_labels, moved, still
+
+
+@lru_cache(maxsize=None)
+def make_dist_cluster_balance_round(mesh: Mesh, *, k: int):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
+                  P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(), P()),
+    )
+    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_bw,
+                 send_idx, recv_map):
+        return _cluster_balance_round_body(
+            key, labels, node_w, edge_u, col_loc, edge_w, max_bw,
+            send_idx, recv_map, k=k,
+        )
+
+    return jax.jit(round_fn)
+
+
 @lru_cache(maxsize=None)
 def make_dist_balance_round(mesh: Mesh, *, k: int):
     @partial(
@@ -177,11 +372,32 @@ def make_dist_balance_round(mesh: Mesh, *, k: int):
     return jax.jit(round_fn)
 
 
+def dist_cluster_balance(mesh, key, labels, graph, max_bw, *, k: int,
+                         max_rounds: int = 8):
+    """Drive deterministic cluster-balance rounds (reference:
+    cluster_balancer.cc).  Returns (labels, feasible)."""
+    fn = make_dist_cluster_balance_round(mesh, k=k)
+    for i in range(max_rounds):
+        labels, moved, still = fn(
+            jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
+            graph.col_loc, graph.edge_w, max_bw, graph.send_idx,
+            graph.recv_map,
+        )
+        if not bool(still):
+            return labels, True
+        if int(moved) == 0:
+            break  # greedy and deterministic: a dry round stays dry
+    return labels, False
+
+
 def dist_balance(mesh, key, labels, graph, max_bw, *, k: int,
                  max_rounds: int = 16):
     """Drive balance rounds until feasible or the budget is exhausted.
 
-    Returns (labels, feasible).  ``max_bw`` is a (k,) block-weight cap."""
+    Node rounds first; when they go dry (3 consecutive rounds without a
+    move — the reference's escalation point), whole-cluster moves take
+    over (``dist_cluster_balance``).  Returns (labels, feasible).
+    ``max_bw`` is a (k,) block-weight cap."""
     fn = make_dist_balance_round(mesh, k=k)
     feasible = False
     dry = 0
@@ -200,4 +416,8 @@ def dist_balance(mesh, key, labels, graph, max_bw, *, k: int,
         dry = dry + 1 if int(moved) == 0 else 0
         if dry >= 3:
             break
+    if not feasible:
+        labels, feasible = dist_cluster_balance(
+            mesh, jax.random.fold_in(key, 1 << 20), labels, graph, max_bw, k=k
+        )
     return labels, feasible

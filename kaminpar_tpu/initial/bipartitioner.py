@@ -542,15 +542,26 @@ def _twoway_budgets(
     exponent = 1.0 / max((k - 1).bit_length(), 1)  # 1/ceil_log2(k)
     adapted_eps = max(base**exponent - 1.0, 1e-4)
     total = s0 + s1
+    # Ceil, not floor: with adapted_eps ~1e-4 and small W, flooring both
+    # sides can leave mw0 + mw1 < W — infeasible by construction (ADVICE r2).
     mw = np.array(
         [
-            int((1.0 + adapted_eps) * W * s0 / total),
-            int((1.0 + adapted_eps) * W * s1 / total),
+            -int(-(1.0 + adapted_eps) * W * s0 // total),
+            -int(-(1.0 + adapted_eps) * W * s1 // total),
         ],
         dtype=np.int64,
     )
     # Never exceed the non-adaptive budgets (the hard constraint).
-    return np.minimum(mw, np.array([s0, s1], dtype=np.int64))
+    mw = np.minimum(mw, np.array([s0, s1], dtype=np.int64))
+    # The clamp can reopen the shortfall; hand it to whichever side has
+    # headroom (s0 + s1 >= W, so the shortfall always fits somewhere).
+    short = W - int(mw.sum())
+    if short > 0:
+        room0 = s0 - int(mw[0])
+        give0 = min(short, room0)
+        mw[0] += give0
+        mw[1] += min(short - give0, s1 - int(mw[1]))
+    return mw
 
 
 def recursive_bipartition(

@@ -160,3 +160,230 @@ def read_metis_sharded(path: str, num_shards: int):
     return from_numpy_csr(
         row_ptr, np.concatenate(cols), np.concatenate(nws), np.concatenate(ews)
     )
+
+
+# ---------------------------------------------------------------------------
+# Chunked ParHIP (binary) parsing.  Reference: kaminpar-io/dist_parhip_parser
+# .cc (485 LoC) — each PE mmaps only its node range.  The binary format is
+# made for this: xadj entries are absolute byte offsets into the adjncy
+# section, so a shard's edge bytes are one contiguous slice.
+# ---------------------------------------------------------------------------
+
+
+def read_parhip_chunked(
+    path: str, num_shards: int
+) -> Iterator[Tuple[int, Tuple[int, int], HostChunk]]:
+    """Yield each shard's node range of a ParHIP file; only that shard's
+    xadj/adjncy/weight byte slices are ever resident (np.memmap windows)."""
+    from .parhip import _HDR
+
+    raw = np.memmap(path, dtype=np.uint8, mode="r")
+    version, n, m = (int(x) for x in np.frombuffer(raw[:_HDR], dtype=np.uint64))
+    has_ew = (version & 1) == 0
+    has_nw = (version & 2) == 0
+    eid_w = 8 if (version & 4) == 0 else 4
+    nid_w = 8 if (version & 8) == 0 else 4
+    nw_w = 8 if (version & 16) == 0 else 4
+    ew_w = 8 if (version & 32) == 0 else 4
+    eid_t = np.uint64 if eid_w == 8 else np.uint32
+    nid_t = np.uint64 if nid_w == 8 else np.uint32
+    nw_t = np.int64 if nw_w == 8 else np.int32
+    ew_t = np.int64 if ew_w == 8 else np.int32
+
+    adj_base = _HDR + (n + 1) * eid_w
+    nw_base = adj_base + m * nid_w
+    ew_base = nw_base + (n * nw_w if has_nw else 0)
+
+    n_loc = -(n // -num_shards)
+    for s in range(num_shards):
+        lo = min(s * n_loc, n)
+        hi = min(lo + n_loc, n)
+        if hi == lo:
+            # Empty trailing shard: row_ptr must be [0], not a slice of the
+            # global xadj (which would double-count m during assembly).
+            yield s, (lo, hi), HostChunk(
+                lo, hi, np.zeros(1, dtype=np.int64),
+                np.zeros(0, dtype=np.int64), np.ones(0, dtype=np.int64),
+                np.zeros(0, dtype=np.int64),
+            )
+            continue
+        xa_off = _HDR + lo * eid_w
+        xadj = np.frombuffer(
+            raw[xa_off : xa_off + (hi - lo + 1) * eid_w], dtype=eid_t
+        ).astype(np.int64)
+        first_e = (int(xadj[0]) - adj_base) // nid_w
+        last_e = (int(xadj[-1]) - adj_base) // nid_w
+        row_ptr = (xadj - adj_base) // nid_w - first_e
+        col = np.frombuffer(
+            raw[adj_base + first_e * nid_w : adj_base + last_e * nid_w],
+            dtype=nid_t,
+        ).astype(np.int64)
+        if has_nw:
+            nw = np.frombuffer(
+                raw[nw_base + lo * nw_w : nw_base + hi * nw_w], dtype=nw_t
+            ).astype(np.int64)
+        else:
+            nw = np.ones(hi - lo, dtype=np.int64)
+        if has_ew:
+            ew = np.frombuffer(
+                raw[ew_base + first_e * ew_w : ew_base + last_e * ew_w],
+                dtype=ew_t,
+            ).astype(np.int64)
+        else:
+            ew = np.ones(last_e - first_e, dtype=np.int64)
+        yield s, (lo, hi), HostChunk(lo, hi, row_ptr, col, nw, ew)
+
+
+def read_parhip_sharded(path: str, num_shards: int):
+    """Assemble a full CSRGraph from the chunked ParHIP reader (testing
+    utility, mirror of read_metis_sharded)."""
+    from ..graph.csr import from_numpy_csr
+
+    rps, cols, nws, ews = [], [], [], []
+    base = 0
+    for _s, (_lo, _hi), ch in read_parhip_chunked(path, num_shards):
+        rps.append(ch.row_ptr[:-1] + base)
+        base += int(ch.row_ptr[-1])
+        cols.append(ch.col_idx)
+        nws.append(ch.node_w)
+        ews.append(ch.edge_w)
+    row_ptr = np.concatenate(rps + [np.asarray([base], dtype=np.int64)])
+    return from_numpy_csr(
+        row_ptr, np.concatenate(cols), np.concatenate(nws), np.concatenate(ews)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Streaming synthetic generation (KaGen analog).  Reference:
+# kaminpar-io/dist_skagen.cc:33-40 — each PE generates only its node range,
+# so scale tests build a DistGraph without a host-resident full CSR.
+# ---------------------------------------------------------------------------
+
+
+def streaming_rmat_sharded(
+    scale: int,
+    edge_factor: int,
+    num_shards: int,
+    seed: int = 0,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    chunk_edges: int = 1 << 20,
+) -> Iterator[Tuple[int, Tuple[int, int], HostChunk]]:
+    """Per-shard RMAT: yields each shard's rows of the symmetrized,
+    deduplicated graph.  The global edge stream is generated in fixed
+    deterministic chunks (seeded per chunk), so every shard sees the same
+    stream and keeps only sources in its range: peak memory is one chunk
+    plus the shard's slice, never the full edge list.  Output is bit-equal
+    to assembling with num_shards=1 by construction."""
+    n = 1 << scale
+    num_edges = edge_factor * n
+    n_loc = -(n // -num_shards)
+    chunks = -(num_edges // -chunk_edges)
+
+    def chunk_pairs(ci: int) -> np.ndarray:
+        rng = np.random.default_rng((seed << 20) ^ ci)
+        cnt = min(chunk_edges, num_edges - ci * chunk_edges)
+        u = np.zeros(cnt, dtype=np.int64)
+        v = np.zeros(cnt, dtype=np.int64)
+        for _bit in range(scale):
+            r = rng.random(cnt)
+            u = (u << 1) | (r >= a + b)
+            v = (v << 1) | ((r >= a) & (r < a + b) | (r >= a + b + c))
+        return np.stack([u, v], axis=1)
+
+    for s in range(num_shards):
+        lo = min(s * n_loc, n)
+        hi = min(lo + n_loc, n)
+        keep_u, keep_v = [], []
+        for ci in range(chunks):
+            e = chunk_pairs(ci)
+            # symmetrize per chunk, then keep rows owned by this shard
+            both_u = np.concatenate([e[:, 0], e[:, 1]])
+            both_v = np.concatenate([e[:, 1], e[:, 0]])
+            mask = (both_u >= lo) & (both_u < hi) & (both_u != both_v)
+            keep_u.append(both_u[mask])
+            keep_v.append(both_v[mask])
+        u = np.concatenate(keep_u) if keep_u else np.zeros(0, dtype=np.int64)
+        v = np.concatenate(keep_v) if keep_v else np.zeros(0, dtype=np.int64)
+        # dedup within the shard's rows (weights collapse to 1, matching
+        # KaGen's simple-graph output rather than weight-summing)
+        key = (u - lo) * n + v
+        order = np.argsort(key, kind="stable")
+        key, u, v = key[order], u[order], v[order]
+        first = np.ones(len(key), dtype=bool)
+        first[1:] = key[1:] != key[:-1]
+        u, v = u[first], v[first]
+        deg = np.bincount(u - lo, minlength=hi - lo)
+        row_ptr = np.zeros(hi - lo + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        yield s, (lo, hi), HostChunk(
+            lo, hi, row_ptr, v, np.ones(hi - lo, dtype=np.int64),
+            np.ones(len(v), dtype=np.int64),
+        )
+
+
+def streaming_rgg2d_sharded(
+    n: int,
+    radius: float,
+    num_shards: int,
+    seed: int = 0,
+) -> Iterator[Tuple[int, Tuple[int, int], HostChunk]]:
+    """Per-shard random geometric graph: positions are an O(n) table
+    (node-sized state is allowed — it is m-sized state the streaming path
+    avoids); each shard computes only the edges of its node range via the
+    cell grid.  Deterministic in (n, radius, seed) independent of
+    num_shards."""
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n, 2))
+    ncell = max(1, int(1.0 / radius))
+    cell = np.minimum((pts * ncell).astype(np.int64), ncell - 1)
+    cell_id = cell[:, 0] * ncell + cell[:, 1]
+    order = np.argsort(cell_id, kind="stable")
+    cid_s = cell_id[order]
+    starts = np.searchsorted(cid_s, np.arange(ncell * ncell))
+    ends = np.searchsorted(cid_s, np.arange(ncell * ncell), side="right")
+    r2 = radius * radius
+
+    n_loc = -(n // -num_shards)
+    for s in range(num_shards):
+        lo = min(s * n_loc, n)
+        hi = min(lo + n_loc, n)
+        us, vs = [], []
+        # vectorized per node-row batch: for each owned node, candidate
+        # neighbors are the nodes of its 3x3 cell neighborhood
+        own = np.arange(lo, hi)
+        if len(own):
+            oc = cell[own]
+            for dx in (-1, 0, 1):
+                for dy in (-1, 0, 1):
+                    cx = oc[:, 0] + dx
+                    cy = oc[:, 1] + dy
+                    ok = (cx >= 0) & (cx < ncell) & (cy >= 0) & (cy < ncell)
+                    if not ok.any():
+                        continue
+                    cids = np.where(ok, cx * ncell + cy, 0)
+                    cnt = np.where(ok, ends[cids] - starts[cids], 0)
+                    tot = int(cnt.sum())
+                    if tot == 0:
+                        continue
+                    row = np.repeat(np.arange(len(own)), cnt)
+                    pos = np.arange(tot) - np.repeat(
+                        np.cumsum(cnt) - cnt, cnt
+                    )
+                    cand = order[np.repeat(starts[cids], cnt) + pos]
+                    d = pts[own[row]] - pts[cand]
+                    close = ((d * d).sum(axis=1) <= r2) & (cand != own[row])
+                    us.append(own[row[close]])
+                    vs.append(cand[close])
+        u = np.concatenate(us) if us else np.zeros(0, dtype=np.int64)
+        v = np.concatenate(vs) if vs else np.zeros(0, dtype=np.int64)
+        order2 = np.lexsort((v, u))
+        u, v = u[order2], v[order2]
+        deg = np.bincount(u - lo, minlength=hi - lo)
+        row_ptr = np.zeros(hi - lo + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        yield s, (lo, hi), HostChunk(
+            lo, hi, row_ptr, v, np.ones(hi - lo, dtype=np.int64),
+            np.ones(len(v), dtype=np.int64),
+        )

@@ -110,39 +110,70 @@ class KaMinPar:
         assert (
             self.graph is not None or self.compressed_graph is not None
         ), "call set_graph/copy_graph first"
-        graph = (
-            self.graph
-            if self.graph is not None
-            else self.compressed_graph.decompress()
-        )
+        # TeraPart compute tier (VERDICT r2 next-steps #5): with a compressed
+        # input the facade never holds the decompressed CSR — budgets come
+        # from compressed metadata and the DEEP partitioner materializes /
+        # releases the finest level itself.
+        graph = self.graph
+        cg = self.compressed_graph if graph is None else None
+        src = graph if graph is not None else cg
         ctx = self.ctx
         if k <= 0:
             raise ValueError("k must be positive")
-        if k > max(graph.n, 1):
-            raise ValueError(f"k={k} exceeds number of nodes {graph.n}")
+        if k > max(src.n, 1):
+            raise ValueError(f"k={k} exceeds number of nodes {src.n}")
 
         RandomState.reseed(ctx.seed)
         Timer.reset_global()
         start = time.perf_counter()
 
-        ctx.partition.setup(graph.total_node_weight, k, epsilon, min_epsilon)
+        total_node_weight = int(src.total_node_weight)
+        max_node_weight = (
+            int(graph.max_node_weight) if graph is not None
+            else int(np.max(cg.node_w, initial=1))
+        )
+        ctx.partition.setup(total_node_weight, k, epsilon, min_epsilon)
         if max_block_weights is not None:
             ctx.partition.max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
         else:
             # strictness adjustment for weighted nodes (kaminpar.cc setup)
-            perfect = (graph.total_node_weight + k - 1) // k
+            perfect = (total_node_weight + k - 1) // k
             ctx.partition.max_block_weights = np.maximum(
-                ctx.partition.max_block_weights, perfect + graph.max_node_weight
+                ctx.partition.max_block_weights, perfect + max_node_weight
             )
         if min_block_weights is not None:
             ctx.partition.min_block_weights = np.asarray(min_block_weights, dtype=np.int64)
 
-        if graph.n == 0:
+        if src.n == 0:
+            from .graph.csr import from_numpy_csr
+
+            empty = graph if graph is not None else from_numpy_csr(
+                np.zeros(1, dtype=np.int64), np.zeros(0, dtype=np.int64)
+            )
             self._last = PartitionedGraph.create(
-                graph, k, np.zeros(0, dtype=np.int32),
+                empty, k, np.zeros(0, dtype=np.int32),
                 ctx.partition.max_block_weights, ctx.partition.min_block_weights,
             )
             return np.zeros(0, dtype=np.int32)
+
+        if graph is None:
+            # Isolated-node preprocessing needs a full CSR rebuild; for the
+            # memory tier it is skipped — LP's isolated-node clustering
+            # (ops/lp.py:cluster_isolated_nodes) handles them in-pipeline.
+            partitioner = create_partitioner(ctx, None, compressed=cg)
+            p_graph = partitioner.partition()
+            self._last = p_graph
+            part = np.asarray(p_graph.partition)
+            elapsed = time.perf_counter() - start
+            log_result_line(
+                p_graph.edge_cut(), p_graph.imbalance(),
+                metrics.is_feasible(
+                    p_graph.graph, part, k, ctx.partition.max_block_weights
+                ),
+                k, elapsed,
+            )
+            Logger.log(Timer.global_().machine_readable(), OutputLevel.EXPERIMENT)
+            return part
 
         # Strip isolated nodes before partitioning and bin-pack them into
         # the lightest blocks afterwards (reference: kaminpar.cc:388-429 —

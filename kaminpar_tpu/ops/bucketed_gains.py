@@ -40,6 +40,7 @@ def _bucket_moves(
     *,
     external_only: bool,
     respect_caps: bool,
+    tie_break: str = "uniform",
 ):
     """Per-row best move for one (R, w) bucket.  Returns (target, tconn,
     own_conn, has_cand), each (R,)."""
@@ -78,6 +79,12 @@ def _bucket_moves(
     best = jnp.max(score, axis=1)
     has = best >= 0
     eligible = ok & (rating == best[:, None]) & has[:, None]
+    if tie_break == "lightest":
+        # Among equally-rated clusters prefer the lightest one (then
+        # random) — see TieBreakingStrategy.LIGHTEST.
+        lw = lookup(label_weights, Ls)
+        lw_m = jnp.where(eligible, lw, jnp.iinfo(lw.dtype).max)
+        eligible = eligible & (lw_m == jnp.min(lw_m, axis=1)[:, None])
     tie = jax.random.randint(key, (R, w), 0, _I32MAX, dtype=jnp.int32)
     tie_m = jnp.where(eligible, tie, -1)
     slot = jnp.argmax(tie_m, axis=1)
@@ -99,6 +106,7 @@ def flat_best_moves(
     num_rows: int,
     external_only: bool,
     respect_caps: bool,
+    tie_break: str = "uniform",
 ):
     """Flat run-reduce best-move kernel over (row, candidate-label, weight)
     slot triples: one variadic sort by (row, label), then run ratings via the
@@ -138,6 +146,13 @@ def flat_best_moves(
     score = jnp.where(ok, rating, -1)
     best = jax.ops.segment_max(score, sr, num_segments=num_rows, indices_are_sorted=True)
     eligible = ok & (rating == best[sr])
+    if tie_break == "lightest":
+        lw = lookup(label_weights, sc)
+        lw_m = jnp.where(eligible, lw, jnp.iinfo(lw.dtype).max)
+        best_lw = jax.ops.segment_min(
+            lw_m, sr, num_segments=num_rows, indices_are_sorted=True
+        )
+        eligible = eligible & (lw_m == best_lw[sr])
     tie = jax.random.randint(key, (S,), 0, _I32MAX, dtype=jnp.int32)
     tie_m = jnp.where(eligible, tie, -1)
     best_tie = jax.ops.segment_max(
@@ -165,6 +180,7 @@ def _heavy_moves(
     *,
     external_only: bool,
     respect_caps: bool,
+    tie_break: str = "uniform",
 ):
     """Heavy rows: the flat kernel with the dense heavy-row index as row key."""
     hnodes, hrow, hcols, hw = heavy
@@ -172,6 +188,7 @@ def _heavy_moves(
         key, hrow, labels[hcols], hw, labels[hnodes], node_w[hnodes],
         label_weights, max_label_weights, num_rows=hnodes.shape[0],
         external_only=external_only, respect_caps=respect_caps,
+        tie_break=tie_break,
     )
 
 
@@ -187,6 +204,7 @@ def bucketed_best_moves(
     *,
     external_only: bool = True,
     respect_caps: bool = True,
+    tie_break: str = "uniform",
 ):
     """Drop-in equivalent of gains.best_moves over the bucketed layout.
 
@@ -208,6 +226,7 @@ def bucketed_best_moves(
                 max_label_weights,
                 external_only=external_only,
                 respect_caps=respect_caps,
+                tie_break=tie_break,
             )
         )
     if heavy.nodes.shape[0] > 0:
@@ -221,6 +240,7 @@ def bucketed_best_moves(
                 max_label_weights,
                 external_only=external_only,
                 respect_caps=respect_caps,
+                tie_break=tie_break,
             )
         )
 

@@ -20,7 +20,10 @@ from .bucketed_gains import lookup
 from .segment import run_ids, run_starts2
 
 
-@partial(jax.jit, static_argnames=("num_labels", "external_only", "respect_caps"))
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "external_only", "respect_caps", "tie_break"),
+)
 def best_moves(
     key,
     labels,
@@ -34,6 +37,7 @@ def best_moves(
     num_labels: int,
     external_only: bool = True,
     respect_caps: bool = True,
+    tie_break: str = "uniform",
 ):
     """Per node: the best-connected (feasible) target block and connections.
 
@@ -80,6 +84,12 @@ def best_moves(
     score = jnp.where(ok, rating, -1)
     best_score = jax.ops.segment_max(score, su, num_segments=n)
     eligible = ok & (rating == best_score[su])
+    if tie_break == "lightest":
+        # see TieBreakingStrategy.LIGHTEST (context.py)
+        lw = lookup(label_weights, sc)
+        lw_m = jnp.where(eligible, lw, jnp.iinfo(lw.dtype).max)
+        best_lw = jax.ops.segment_min(lw_m, su, num_segments=n)
+        eligible = eligible & (lw_m == best_lw[su])
     tie = jax.random.randint(key, (m,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
     tie_masked = jnp.where(eligible, tie, -1)
     best_tie = jax.ops.segment_max(tie_masked, su, num_segments=n)

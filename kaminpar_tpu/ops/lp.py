@@ -165,7 +165,10 @@ def _commit_moves(
     return LPState(new_labels, new_weights, jnp.sum(commit).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("num_labels", "active_prob", "allow_tie_moves"))
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+)
 def lp_round_bucketed(
     state: LPState,
     key,
@@ -178,13 +181,14 @@ def lp_round_bucketed(
     num_labels: int,
     active_prob: float = 1.0,
     allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
 ) -> LPState:
     """lp_round over the degree-bucketed layout (the fast path)."""
     kr, kp = jax.random.split(key)
     target, tconn, own_conn, _ = bucketed_best_moves(
         kr, state.labels, buckets, heavy, gather_idx, node_w,
         state.label_weights, max_label_weights,
-        external_only=False, respect_caps=True,
+        external_only=False, respect_caps=True, tie_break=tie_break,
     )
     return _commit_moves(
         state, kp, target, tconn, own_conn, node_w, max_label_weights, num_labels,
@@ -221,7 +225,13 @@ def lp_round_colored(
     )
 
 
-@partial(jax.jit, static_argnames=("num_labels", "max_iterations", "active_prob", "allow_tie_moves"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "num_labels", "max_iterations", "active_prob", "allow_tie_moves",
+        "tie_break",
+    ),
+)
 def lp_iterate_bucketed(
     state: LPState,
     key,
@@ -236,6 +246,7 @@ def lp_iterate_bucketed(
     max_iterations: int,
     active_prob: float = 1.0,
     allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
 ) -> LPState:
     """Up to ``max_iterations`` LP rounds fused into one on-device while loop
     with the early-exit condition (< min_moved nodes moved) evaluated on
@@ -252,6 +263,7 @@ def lp_iterate_bucketed(
             st, jax.random.fold_in(key, i), buckets, heavy, gather_idx,
             node_w, max_label_weights, num_labels=num_labels,
             active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+            tie_break=tie_break,
         )
         return i + 1, st
 

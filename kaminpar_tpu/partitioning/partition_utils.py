@@ -24,11 +24,15 @@ import numpy as np
 
 
 def compute_k_for_n(n: int, contraction_limit: int, k: int) -> int:
-    """Blocks a graph with n nodes should carry (reference:
-    partition_utils.cc:92-100 — note *ceil*_log2: extension is front-loaded
-    onto coarse levels, where bisections are cheap and every subsequent
-    level refines at the higher k; floor would back-load a huge extension
-    jump onto the finest level where refinement can no longer recover)."""
+    """Blocks a graph with n nodes should carry.
+
+    DIVERGENCE (DIVERGENCES.md #13) from partition_utils.cc:92-100: the
+    reference floors n/C before ceil_log2; we *ceil* it, so for n just
+    above 2C this returns 4 where the reference returns 2.  Extension is
+    thereby front-loaded onto coarse levels, where bisections are cheap
+    and every subsequent level refines at the higher k; flooring would
+    back-load a large extension jump onto the finest level where
+    refinement can no longer recover it."""
     if n < 2 * contraction_limit:
         return 2
     ratio = -(n // -contraction_limit)  # ceil(n / C)

@@ -2,43 +2,50 @@
 
 The reference keeps thread-local ``std::mt19937`` singletons with a global
 reseed (``kaminpar-common/random.h:27-60``).  In JAX the idiomatic equivalent
-is functional key threading; this module provides a tiny global key-chain so
+is functional key threading; this module provides a tiny key-chain so
 host-side orchestration code can draw fresh keys deterministically from one
 seed, matching ``Random::reseed``.
+
+Storage is **thread-local** (like the reference's ets singletons): the
+concurrent best-of-R initial-partitioning replicas (dist/partitioner.py)
+reseed their worker threads independently, so each rep's stream is
+deterministic in (seed, rep) regardless of thread scheduling, and the main
+thread's stream is never perturbed by worker draws.
 """
 
 from __future__ import annotations
+
+import threading
 
 import jax
 import numpy as np
 
 
 class RandomState:
-    _key = None
-    _seed = 0
+    _tls = threading.local()
 
     @classmethod
     def reseed(cls, seed: int) -> None:
-        cls._seed = int(seed)
-        cls._key = jax.random.key(int(seed))
+        cls._tls.seed = int(seed)
+        cls._tls.key = jax.random.key(int(seed))
 
     @classmethod
     def seed(cls) -> int:
-        return cls._seed
+        if getattr(cls._tls, "key", None) is None:
+            cls.reseed(0)
+        return cls._tls.seed
 
     @classmethod
     def next_key(cls):
-        if cls._key is None:
+        if getattr(cls._tls, "key", None) is None:
             cls.reseed(0)
-        cls._key, sub = jax.random.split(cls._key)
+        cls._tls.key, sub = jax.random.split(cls._tls.key)
         return sub
 
     @classmethod
     def numpy_rng(cls) -> np.random.Generator:
         """Host-side RNG for the sequential initial partitioner, derived from
         the same seed chain."""
-        if cls._key is None:
-            cls.reseed(0)
         data = jax.random.key_data(cls.next_key())
         return np.random.default_rng(np.asarray(data).astype(np.uint32))
 

@@ -40,7 +40,7 @@ class Timer:
     def __init__(self, name: str = "root"):
         self._root = _TimerNode(name)
         self._stack = [self._root]
-        self._enabled = True
+        self._disabled = 0  # depth counter: parallel sections nest
         self._t0 = time.perf_counter()
 
     @classmethod
@@ -54,16 +54,19 @@ class Timer:
         cls._global = Timer()
 
     def enable(self) -> None:
-        self._enabled = True
+        self._disabled = max(self._disabled - 1, 0)
 
     def disable(self) -> None:
         """Reference disables timers during parallel IP
-        (deep_multilevel.cc:213); we disable during per-block host work."""
-        self._enabled = False
+        (deep_multilevel.cc:213); we disable during per-block host work.
+        disable/enable nest as a depth counter: an inner parallel section's
+        re-enable must not reactivate the (thread-unsafe) scope stack while
+        an outer parallel section still has worker threads running."""
+        self._disabled += 1
 
     @contextmanager
     def scope(self, name: str):
-        if not self._enabled:
+        if self._disabled:
             yield
             return
         node = self._stack[-1].child(name)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Road-network config evaluation (VERDICT r2 next-steps #3, BASELINE eval
+config 3 analog).
+
+USA-road-d cannot be fetched (zero egress); per the verdict a large grid
+with random edge weights approximates its class (low degree, high diameter).
+Measures the reference binary at -P default/eco/strong (strong = the flow
+preset) vs ours at default/eco/strong on k=64, so the flow-refiner question
+is settled on the graph class where FlowCutter actually pays.
+
+Usage: python scripts/road_eval.py [--side 512] [--seeds 1,2] [--ours-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_BIN = os.path.join(REPO, "build_ref", "apps", "KaMinPar")
+DATA = os.path.join(REPO, "bench_data")
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, REPO)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+
+def fixture(side: int) -> str:
+    import numpy as np
+
+    from kaminpar_tpu.graph.csr import CSRGraph
+    from kaminpar_tpu.graph.generators import grid2d_graph
+    from kaminpar_tpu.io import write_metis
+
+    os.makedirs(DATA, exist_ok=True)
+    path = os.path.join(DATA, f"road{side}.metis")
+    if not os.path.exists(path):
+        g0 = grid2d_graph(side, side)
+        # random integer "travel time" weights, symmetric by construction:
+        # weight = f(min(u,v), max(u,v))
+        rp = np.asarray(g0.row_ptr)
+        col = np.asarray(g0.col_idx).astype(np.int64)
+        u = np.repeat(np.arange(g0.n, dtype=np.int64), np.diff(rp))
+        key = np.minimum(u, col) * g0.n + np.maximum(u, col)
+        ew = (key * 2654435761 % 9 + 1).astype(np.int32)
+        g = CSRGraph(g0.row_ptr, g0.col_idx, None, ew)
+        write_metis(g, path)
+        print(f"wrote {path} n={g.n} m={g.m}", file=sys.stderr)
+    return path
+
+
+def run_ref(path: str, k: int, seed: int, preset: str):
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [REF_BIN, path, str(k), "-P", preset, f"--seed={seed}", "-t", "1"],
+        capture_output=True, text=True, timeout=7200,
+    )
+    wall = time.perf_counter() - t0
+    if out.returncode != 0:
+        raise RuntimeError(f"ref {preset} failed: {out.stderr[-300:]}")
+    return int(re.search(r"Edge cut:\s+(\d+)", out.stdout).group(1)), wall
+
+
+def run_ours(path: str, k: int, seed: int, preset: str):
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.io import read_metis
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name(preset)
+    ctx.seed = seed
+    g = read_metis(path)
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    t0 = time.perf_counter()
+    part = s.compute_partition(k, epsilon=0.03)
+    wall = time.perf_counter() - t0
+    return int(metrics.edge_cut(g, part)), wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--side", type=int, default=512)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--seeds", default="1,2")
+    ap.add_argument("--skip-ref", action="store_true")
+    ap.add_argument("--presets", default="default,eco,strong")
+    args = ap.parse_args()
+    path = fixture(args.side)
+    seeds = [int(s) for s in args.seeds.split(",")]
+    results = {}
+    for preset in args.presets.split(","):
+        if not args.skip_ref:
+            cuts, walls = zip(*(run_ref(path, args.k, s, preset) for s in seeds))
+            results[f"ref-{preset}"] = dict(
+                cut=sum(cuts) / len(cuts), wall=sum(walls) / len(walls)
+            )
+            print(f"ref  {preset:8s} cut {results[f'ref-{preset}']['cut']:9.0f} "
+                  f"wall {results[f'ref-{preset}']['wall']:7.1f}s", flush=True)
+        cuts, walls = zip(*(run_ours(path, args.k, s, preset) for s in seeds))
+        results[f"ours-{preset}"] = dict(
+            cut=sum(cuts) / len(cuts), wall=sum(walls) / len(walls)
+        )
+        print(f"ours {preset:8s} cut {results[f'ours-{preset}']['cut']:9.0f} "
+              f"wall {results[f'ours-{preset}']['wall']:7.1f}s", flush=True)
+    with open(os.path.join(DATA, f"road{args.side}_eval.json"), "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -104,3 +104,68 @@ def test_dkaminpar_endtoend_strictly_feasible(gen, k):
     assert metrics.is_feasible(
         g, part, k, jnp.full(k, bw, dtype=jnp.int32)
     )
+
+
+def test_cluster_balancer_direct_restores_feasibility():
+    """The cluster tier alone (no node rounds) repairs an infeasible seed
+    by moving whole clusters (reference: cluster_balancer.cc)."""
+    from kaminpar_tpu.dist.balancer import dist_cluster_balance
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(16, 16)
+    k = 4
+    dg = distribute_graph(g, mesh.size)
+    part = np.zeros(dg.N, dtype=np.int32)
+    # pre-seed the other blocks with a few nodes so every target exists
+    part[: g.n][64:80] = 1
+    part[: g.n][80:96] = 2
+    part[: g.n][96:112] = 3
+    labels, dgs = shard_arrays(mesh, dg, jnp.asarray(part))
+    bw = _max_bw(g, k)
+    cap = jnp.full(k, bw, dtype=jnp.int32)
+    out, feasible = dist_cluster_balance(
+        mesh, jax.random.key(0), labels, dgs, cap, k=k, max_rounds=64
+    )
+    assert feasible
+    w = np.bincount(np.asarray(out)[: g.n], weights=np.asarray(g.node_w),
+                    minlength=k)
+    assert w.max() <= bw
+
+
+def test_cluster_balancer_escalation_on_binpack_stuck():
+    """Bin-packing stuck case: every mover weighs 10 and each receiver has
+    room for exactly one mover.  The node balancer's probabilistic
+    commitments routinely bounce (two simultaneous arrivals at a block roll
+    back), while the deterministic greedy cluster tier moves exactly one
+    unit per block per round — dist_balance must end feasible either way
+    (VERDICT r2 next-steps #6 seeded stuck fixture)."""
+    mesh = _mesh()
+    rows, cols = 8, 16
+    g0 = generators.grid2d_graph(rows, cols)
+    import kaminpar_tpu.graph.csr as csr_mod
+
+    nw = np.ones(g0.n, dtype=np.int32)
+    # the left 2 columns are heavy movers
+    heavy = (np.arange(g0.n) % cols) < 2
+    nw[heavy] = 10
+    g = csr_mod.CSRGraph(g0.row_ptr, g0.col_idx, nw, g0.edge_w)
+    k = 8
+    dg = distribute_graph(g, mesh.size)
+    part = np.zeros(dg.N, dtype=np.int32)
+    # blocks 1..7 exist, each with a couple of light nodes
+    body = np.arange(g.n)[~heavy]
+    for b in range(1, k):
+        part[body[(b - 1) * 2 : b * 2]] = b
+    labels, dgs = shard_arrays(mesh, dg, jnp.asarray(part))
+    # caps: every block can take one heavy node above its seed weight
+    w0 = np.bincount(part[: g.n], weights=nw, minlength=k)
+    cap_np = np.full(k, int(w0[1:].max()) + 11, dtype=np.int32)
+    # block 0 must shed weight down to its cap
+    cap_np[0] = int(w0[0]) - 3 * 10 + 5  # force >= 3 heavy departures
+    cap = jnp.asarray(cap_np)
+    out, feasible = dist_balance(
+        mesh, jax.random.key(5), labels, dgs, cap, k=k
+    )
+    assert feasible
+    w = np.bincount(np.asarray(out)[: g.n], weights=nw, minlength=k)
+    assert (w <= cap_np).all()
