@@ -156,6 +156,21 @@ class ClusterCoarsener:
                 coarse_comm = jax.ops.segment_max(
                     comm, coarse_of, num_segments=coarse.n
                 )
+        s_ctx = self.ctx.coarsening.sparsification
+        if s_ctx.enabled and coarse.m > 0:
+            # Threshold sparsification (sparsification_cluster_coarsener.cc
+            # :42-49,89): target = min(edge_target * old_m,
+            # density_target * old_m/old_n * new_n); lazily skipped unless
+            # the coarse graph overshoots by laziness_factor.
+            target_m = min(
+                s_ctx.edge_target_factor * graph.m,
+                s_ctx.density_target_factor * graph.m / max(graph.n, 1) * coarse.n,
+            )
+            target_m = int(min(target_m, coarse.m))
+            if coarse.m > s_ctx.laziness_factor * target_m:
+                from .sparsifier import sparsify_threshold
+
+                coarse = sparsify_threshold(coarse, target_m)
         shrink = 1.0 - coarse.n / max(graph.n, 1)
         Logger.log(
             f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "

@@ -72,6 +72,13 @@ class LPClustering:
         # uniform and a scalar saves one m-sized gather per round
         max_w = jnp.asarray(int(max_cluster_weight), dtype=idt)
 
+        iters = self.ctx.num_iterations
+        if (
+            graph.n > 0
+            and graph.m / graph.n < self.ctx.low_degree_boost_threshold
+        ):
+            # see LabelPropagationContext.low_degree_boost_threshold
+            iters *= max(self.ctx.low_degree_boost_factor, 1)
         state = lp.lp_iterate_bucketed(
             state,
             next_key(),
@@ -82,7 +89,7 @@ class LPClustering:
             max_w,
             jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
             num_labels=n_pad,
-            max_iterations=self.ctx.num_iterations,
+            max_iterations=iters,
             active_prob=self.ctx.active_prob,
             tie_break=self.ctx.tie_breaking.value,
         )

@@ -1,0 +1,72 @@
+"""Threshold edge sparsification (the ESA'25 linear-time coarsening tier).
+
+Reference: ``kaminpar-shm/coarsening/sparsification_cluster_coarsener.cc``
+(:175-228 ``recontract_with_threshold_sparsification``): keep every coarse
+edge strictly heavier than the (m - target_m + 1)-smallest weight, and
+sample equal-weight edges with the leftover probability using a *symmetric*
+hash of the endpoints, so both directions of an undirected edge survive or
+die together (the reference's ``throw_dice``, :201-215).
+
+Host-side NumPy: sparsification runs once per level on the freshly
+contracted graph (whose CSR build is host work anyway); the O(m) partition
++ mask is negligible next to the contraction sort.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.csr import CSRGraph
+from ..utils import RandomState
+
+
+def _symmetric_hash01(u: np.ndarray, v: np.ndarray, seed: int) -> np.ndarray:
+    """splitmix-style mix of the unordered endpoint pair -> uniform [0, 1)."""
+    h = (
+        (np.maximum(u, v).astype(np.uint64) << np.uint64(32))
+        | np.minimum(u, v).astype(np.uint64)
+    ) + np.uint64(seed)
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xC4CEB9FE1A85EC53)
+    h ^= h >> np.uint64(33)
+    h &= np.uint64((1 << 32) - 1)
+    return h.astype(np.float64) / float((1 << 32) - 1)
+
+
+def sparsify_threshold(graph: CSRGraph, target_m: int) -> CSRGraph:
+    """Return a copy of ``graph`` with ~``target_m`` heaviest edges kept."""
+    m = graph.m
+    if target_m >= m or m == 0:
+        return graph
+    rp = np.asarray(graph.row_ptr).astype(np.int64)
+    col = np.asarray(graph.col_idx).astype(np.int64)
+    ew = np.asarray(graph.edge_w).astype(np.int64)
+    u = np.repeat(np.arange(graph.n, dtype=np.int64), np.diff(rp))
+
+    if target_m < 2:
+        keep = np.zeros(m, dtype=bool)
+    else:
+        # (m - target_m + 1)-smallest weight = the threshold; edges above it
+        # all fit, equal ones are sampled with the leftover probability.
+        kth = m - target_m  # 0-indexed partition point
+        part = np.partition(ew, kth)
+        threshold = int(part[kth])
+        n_larger = int((ew > threshold).sum())
+        n_equal = int((ew == threshold).sum())
+        p_equal = (target_m - n_larger) / max(n_equal, 1)
+        seed = int(RandomState.numpy_rng().integers(1 << 62))
+        dice = _symmetric_hash01(u, col, seed) < p_equal
+        keep = (ew > threshold) | ((ew == threshold) & dice)
+
+    new_deg = np.bincount(u[keep], minlength=graph.n)
+    new_rp = np.zeros(graph.n + 1, dtype=np.int64)
+    np.cumsum(new_deg, out=new_rp[1:])
+    idt = np.asarray(graph.col_idx).dtype
+    return CSRGraph(
+        new_rp.astype(np.asarray(graph.row_ptr).dtype),
+        col[keep].astype(idt),
+        graph.node_w,
+        ew[keep].astype(np.asarray(graph.edge_w).dtype),
+    )

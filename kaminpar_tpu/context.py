@@ -103,6 +103,29 @@ class LabelPropagationContext:
     # refiner's tie behavior, lp_refiner.cc:258-260); requires
     # active_prob < 1 to stay oscillation-safe under synchronous commits.
     allow_tie_moves: bool = False
+    # Low-degree boost (round-3 A/B, BASELINE_measured.md): synchronous LP
+    # propagates labels one hop per sweep, so sparse graphs (grids, roads)
+    # converge slower per sweep than dense ones — measured grid256 k=64
+    # ratio 1.46 -> 1.20 at 3x sweeps, while 2x sweeps *hurt* dense
+    # geometric rgg64k (1.26 -> 1.39).  Levels with avg degree below the
+    # threshold get factor x num_iterations.
+    low_degree_boost_threshold: float = 8.0
+    low_degree_boost_factor: int = 3
+
+
+@dataclass
+class SparsificationContext:
+    """Threshold edge sparsification after contraction (reference:
+    ``SparsificationClusterCoarseningContext`` + the threshold-sparsifying
+    coarsener, sparsification_cluster_coarsener.cc:42-228, ESA'25): keep
+    the target_m heaviest coarse edges (ties sampled by a symmetric hash so
+    both directions agree), bounding per-level work for worst-case
+    linear-time coarsening.  Defaults = reference presets.cc:172-177."""
+
+    enabled: bool = False
+    density_target_factor: float = 0.5
+    edge_target_factor: float = 0.5
+    laziness_factor: float = 4.0
 
 
 @dataclass
@@ -131,6 +154,9 @@ class CoarseningContext:
     # rounder clusters (variance of any single randomized run cancels).
     # <= 1 disables.
     overlay_levels: int = 1
+    sparsification: SparsificationContext = field(
+        default_factory=SparsificationContext
+    )
 
 
 @dataclass

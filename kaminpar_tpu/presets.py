@@ -177,11 +177,13 @@ def create_vcycle_context(restricted: bool = False) -> Context:
 
 
 def create_linear_time_kway_context() -> Context:
-    """Reference: ``create_linear_time_kway_context`` — single-shot k-way
-    with LP-only refinement for linear total work."""
+    """Reference: ``create_linear_time_kway_context`` (presets.cc:685-690)
+    — single-shot k-way with the threshold-sparsifying coarsener for
+    worst-case linear total work."""
     ctx = create_kway_context()
     ctx.preset_name = "linear-time-kway"
     ctx.coarsening.lp.num_iterations = 2
+    ctx.coarsening.sparsification.enabled = True
     ctx.refinement.algorithms = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,

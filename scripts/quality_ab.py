@@ -95,6 +95,14 @@ VARIANTS = {
     "iters10": {"lp_iters": 10},
     "ap75": {"active_prob": 0.75},
     "ov2+jet+it10": {"overlay": 2, "jet": True, "lp_iters": 10},
+    "it10+ap75": {"lp_iters": 10, "active_prob": 0.75},
+    "it10+jet": {"lp_iters": 10, "jet": True},
+    "it15": {"lp_iters": 15},
+    "it10+ov2": {"lp_iters": 10, "overlay": 2},
+    "ov2+ap75": {"overlay": 2, "active_prob": 0.75},
+    "it15+ap75": {"lp_iters": 15, "active_prob": 0.75},
+    "ap60": {"active_prob": 0.6},
+    "noboost": {"boost_factor": 1},
 }
 
 
@@ -117,6 +125,8 @@ def our_cut(path: str, k: int, seed: int, variant: dict, preset: str) -> tuple:
         ctx.coarsening.lp.num_iterations = variant["lp_iters"]
     if variant.get("active_prob"):
         ctx.coarsening.lp.active_prob = variant["active_prob"]
+    if variant.get("boost_factor") is not None:
+        ctx.coarsening.lp.low_degree_boost_factor = variant["boost_factor"]
     if variant.get("jet") and RefinementAlgorithm.JET not in ctx.refinement.algorithms:
         algs = list(ctx.refinement.algorithms)
         algs.insert(

@@ -128,3 +128,47 @@ def test_metrics_block_weights_imbalance():
     assert metrics.is_feasible(g, part, 2, [3, 3])
     assert not metrics.is_feasible(g, part, 2, [2, 2])
     assert metrics.total_overload(g, part, 2, [2, 2]) == 1
+
+
+def test_sparsify_threshold_keeps_heaviest_and_symmetry():
+    """Threshold sparsifier (sparsification_cluster_coarsener.cc:175-228):
+    ~target_m heaviest edges survive; both directions agree."""
+    import numpy as np
+
+    from kaminpar_tpu.graph import generators
+    from kaminpar_tpu.graph.csr import CSRGraph, from_edge_list
+    from kaminpar_tpu.coarsening.sparsifier import sparsify_threshold
+
+    g0 = generators.rgg2d_graph(512, seed=9)
+    rp = np.asarray(g0.row_ptr); col = np.asarray(g0.col_idx)
+    u = np.repeat(np.arange(g0.n), np.diff(rp))
+    key = np.minimum(u, col) * g0.n + np.maximum(u, col)
+    g = from_edge_list(
+        g0.n, np.stack([u, col], 1), edge_weights=(key % 17 + 1),
+        symmetrize=False, dedup=False,
+    )
+    target = g.m // 3
+    s = sparsify_threshold(g, target)
+    # tie edges are hash-sampled independently -> binomial deviation
+    assert abs(s.m - target) <= max(0.1 * target, 4)
+    # only edges were dropped, none invented; the heaviest all survive
+    sw = np.asarray(s.edge_w)
+    thresh_kept = sw.min()
+    ew = np.asarray(g.edge_w)
+    assert (np.sort(sw)[::-1][: (ew > thresh_kept).sum()] > thresh_kept).all()
+    # symmetric: (u, v) kept iff (v, u) kept
+    su = np.repeat(np.arange(s.n), np.diff(np.asarray(s.row_ptr)))
+    scol = np.asarray(s.col_idx)
+    pairs = set(zip(su.tolist(), scol.tolist()))
+    assert all((v, w) in pairs for w, v in pairs)
+
+
+def test_linear_time_kway_preset_end_to_end():
+    from kaminpar_tpu.graph import generators, metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    g = generators.rmat_graph(10, 8, seed=2)
+    s = KaMinPar("linear-time-kway")
+    s.set_graph(g)
+    part = s.compute_partition(k=8)
+    assert metrics.is_feasible(g, part, 8, s.ctx.partition.max_block_weights)
