@@ -167,7 +167,9 @@ class ClusterCoarsener:
                 s_ctx.density_target_factor * graph.m / max(graph.n, 1) * coarse.n,
             )
             target_m = int(min(target_m, coarse.m))
-            if coarse.m > s_ctx.laziness_factor * target_m:
+            # target_m < 2 would delete every edge (sparsify's guard branch)
+            # — degenerate inputs (mostly-isolated graphs) keep their edges.
+            if target_m >= 2 and coarse.m > s_ctx.laziness_factor * target_m:
                 from .sparsifier import sparsify_threshold
 
                 coarse = sparsify_threshold(coarse, target_m)

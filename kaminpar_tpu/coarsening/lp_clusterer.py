@@ -88,8 +88,8 @@ class LPClustering:
             pv.node_w,
             max_w,
             jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+            jnp.int32(iters),
             num_labels=n_pad,
-            max_iterations=iters,
             active_prob=self.ctx.active_prob,
             tie_break=self.ctx.tie_breaking.value,
         )

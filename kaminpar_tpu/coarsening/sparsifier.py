@@ -40,10 +40,9 @@ def sparsify_threshold(graph: CSRGraph, target_m: int) -> CSRGraph:
     m = graph.m
     if target_m >= m or m == 0:
         return graph
-    rp = np.asarray(graph.row_ptr).astype(np.int64)
     col = np.asarray(graph.col_idx).astype(np.int64)
     ew = np.asarray(graph.edge_w).astype(np.int64)
-    u = np.repeat(np.arange(graph.n, dtype=np.int64), np.diff(rp))
+    u = np.asarray(graph.edge_u).astype(np.int64)
 
     if target_m < 2:
         keep = np.zeros(m, dtype=bool)

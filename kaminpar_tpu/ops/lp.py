@@ -227,10 +227,7 @@ def lp_round_colored(
 
 @partial(
     jax.jit,
-    static_argnames=(
-        "num_labels", "max_iterations", "active_prob", "allow_tie_moves",
-        "tie_break",
-    ),
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
 )
 def lp_iterate_bucketed(
     state: LPState,
@@ -241,9 +238,9 @@ def lp_iterate_bucketed(
     node_w,
     max_label_weights,
     min_moved,
+    max_iterations,
     *,
     num_labels: int,
-    max_iterations: int,
     active_prob: float = 1.0,
     allow_tie_moves: bool = False,
     tie_break: str = "uniform",
@@ -251,7 +248,11 @@ def lp_iterate_bucketed(
     """Up to ``max_iterations`` LP rounds fused into one on-device while loop
     with the early-exit condition (< min_moved nodes moved) evaluated on
     device — one dispatch per clustering instead of one per round (the
-    host-loop equivalent of lp_clusterer.cc:94-105)."""
+    host-loop equivalent of lp_clusterer.cc:94-105).  ``max_iterations`` is a
+    traced scalar (like ``min_moved``): it only feeds the while-loop cond, and
+    keeping it dynamic means one compile per shape bucket even when the
+    low-degree boost varies the sweep budget across levels."""
+    max_iterations = jnp.asarray(max_iterations, dtype=jnp.int32)
 
     def cond(carry):
         i, st = carry

@@ -41,8 +41,8 @@ class LPRefiner(Refiner):
                 pv.node_w,
                 max_w,
                 jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+                jnp.int32(self.ctx.num_iterations),
                 num_labels=k,
-                max_iterations=self.ctx.num_iterations,
                 active_prob=self.ctx.active_prob,
                 allow_tie_moves=self.ctx.allow_tie_moves,
             )

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""largek proof point (VERDICT r2 next-steps #9).
+
+Runs k=4096 on a ~1M-node graph with the largek preset, prints the RESULT
+line and the timer tree so the extension cost is visible (the reference's
+flagship largek story is k=30 000, README.MD:16; largek presets tune
+contraction_limit=640, presets.cc).
+
+Usage: python scripts/largek_proof.py [--scale 20] [--k 4096] [--preset largek]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, REPO)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--k", type=int, default=4096)
+    ap.add_argument("--preset", default="largek")
+    ap.add_argument("--edge-factor", type=int, default=8)
+    args = ap.parse_args()
+
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.utils import Logger, OutputLevel, Timer
+
+    Logger.level = OutputLevel.EXPERIMENT
+    t0 = time.perf_counter()
+    g = rmat_graph(args.scale, edge_factor=args.edge_factor, seed=1)
+    print(f"generated n={g.n} m={g.m} in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr, flush=True)
+
+    s = KaMinPar(args.preset)
+    s.set_graph(g)
+    t0 = time.perf_counter()
+    part = s.compute_partition(args.k, epsilon=0.03)
+    wall = time.perf_counter() - t0
+
+    cut = int(metrics.edge_cut(g, part))
+    feas = metrics.is_feasible(g, part, args.k, s.ctx.partition.max_block_weights)
+    tree = Timer.global_().machine_readable()
+    print(tree, flush=True)
+    rec = {
+        "config": f"rmat{args.scale} k={args.k} preset={args.preset}",
+        "cut": cut, "feasible": bool(feas), "wall_s": round(wall, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    out = os.path.join(REPO, "bench_data", f"largek_{args.scale}_{args.k}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"result": rec, "timer": tree}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

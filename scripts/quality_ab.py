@@ -103,6 +103,8 @@ VARIANTS = {
     "it15+ap75": {"lp_iters": 15, "active_prob": 0.75},
     "ap60": {"active_prob": 0.6},
     "noboost": {"boost_factor": 1},
+    "extreps2": {"ext_reps": 2},
+    "extreps3": {"ext_reps": 3},
 }
 
 
@@ -127,6 +129,8 @@ def our_cut(path: str, k: int, seed: int, variant: dict, preset: str) -> tuple:
         ctx.coarsening.lp.active_prob = variant["active_prob"]
     if variant.get("boost_factor") is not None:
         ctx.coarsening.lp.low_degree_boost_factor = variant["boost_factor"]
+    if variant.get("ext_reps"):
+        ctx.initial_partitioning.nested_extension_reps = variant["ext_reps"]
     if variant.get("jet") and RefinementAlgorithm.JET not in ctx.refinement.algorithms:
         algs = list(ctx.refinement.algorithms)
         algs.insert(

@@ -166,7 +166,7 @@ def test_lp_iterate_bucketed(rng):
     state = lp.init_state(labels, pv.node_w, n_pad)
     out = lp.lp_iterate_bucketed(
         state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
-        pv.node_w, max_w, jnp.int32(0), num_labels=n_pad, max_iterations=5,
+        pv.node_w, max_w, jnp.int32(0), jnp.int32(5), num_labels=n_pad,
     )
     lab = np.asarray(out.labels)[: graph.n]
     assert len(np.unique(lab)) < graph.n  # clustering actually happened
