@@ -190,10 +190,12 @@ class InitialPartitioningContext:
     # measured stronger on dense geometric graphs (extend_partition).
     nested_extension_n: int = 4096
     # Independent nested attempts per extension block; best cut wins.
-    # Measured on rgg64k k=64: reps=2 cuts seed variance ~4x (spread 8.9k
-    # -> 1.9k) at unchanged mean for 2x extension cost — default 1, raise
-    # for variance-sensitive runs.
-    nested_extension_reps: int = 1
+    # Round-2 measured on rgg64k k=64: reps=2 cuts seed variance ~4x
+    # (spread 8.9k -> 1.9k) at unchanged mean; round-3 on grid256 k=64 it
+    # moves the default-tier mean 1.38 -> 1.24 over seeds {1,2,3}
+    # (QUALITY_NOTES.md) — bad extension splits were the variance source.
+    # Cost: ~+20% wall on mesh configs.  Default 2 since round 3.
+    nested_extension_reps: int = 2
     # Up to this finest-graph size, also run the flat pool on the finest
     # graph and keep the better of {mini-ML, flat} — measured divergence
     # from the reference (which always uses ML): on expander-like coarse
