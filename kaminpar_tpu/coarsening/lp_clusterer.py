@@ -38,10 +38,26 @@ def _intersect_clusterings(la, lb):
     return jnp.zeros_like(la).at[order].set(rep[rid])
 
 
+_warned_geometric = False
+
+
 class LPClustering:
     def __init__(self, ctx: LabelPropagationContext, overlay_levels: int = 1):
         self.ctx = ctx
         self.overlay_levels = max(int(overlay_levels), 1)
+        global _warned_geometric
+        if ctx.tie_breaking.value == "geometric" and not _warned_geometric:
+            # Kernels implement 'uniform' and 'lightest' only; surface the
+            # degradation instead of silently ignoring the configured
+            # strategy.  Once per process: __init__ re-runs per hierarchy
+            # level and per dist replica worker.
+            _warned_geometric = True
+            from ..utils.logger import Logger
+
+            Logger.warning(
+                "lp: tie_breaking=geometric is not implemented by the TPU "
+                "kernels; falling back to uniform tie-breaking"
+            )
 
     def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
         """Returns padded labels (over graph.padded()); pad nodes carry the

@@ -156,6 +156,10 @@ class DKaMinPar:
             # (kaminpar.py) — side effects the enclosing dist pipeline (open
             # scoped_timer scopes, its own RNG stream) must not see.  Same
             # pattern as partitioning/deep._nested_partition (ADVICE r2 #1).
+            # Intentionally also skips the facade's isolated-node strip +
+            # bin-pack: contracted coarse graphs may contain isolated nodes
+            # (zero-cut either way) and stripping would perturb the replica
+            # RNG streams; refinement rebalances any placement slack.
             def one_rep(r: int):
                 # Worker-thread RNG stream: deterministic in (seed, rep)
                 # regardless of scheduling (RandomState is thread-local).

@@ -42,16 +42,17 @@ from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
 
-_NEG = np.int64(-(1 << 62))
-
-
-def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, rng, ctx):
+def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, rng, ctx, conn):
     """One FM pass; mutates part/bw in place, returns the cut delta (<= 0)."""
     n = len(row_ptr) - 1
+    _NEG = np.iinfo(conn.dtype).min // 2
 
     # Dense block-connection matrix: C[u, b] = sum of edge weights from u
     # into block b (the reference's dense gain cache, dense_gain_cache.h).
-    conn = np.zeros((n, k), dtype=np.int64)
+    # The buffer is allocated once in refine() (int32 when total edge weight
+    # permits) and reset here — at the max_nk gate a fresh int64 allocation
+    # would be 512 MiB per pass (ADVICE r3 #3).
+    conn.fill(0)
     np.add.at(conn, (u_arr, part[col_idx]), edge_w)
 
     cols = np.arange(k)
@@ -85,6 +86,12 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
             return -1, 0
         gains = np.where(valid, row - row[own], _NEG)
         to = int(np.argmax(gains))
+        # Real gains stay strictly above _NEG: the int32 path is gated on
+        # directed edge_w.sum() < 2^31, so internal < 2^30 = -_NEG.  Guard
+        # anyway so a masked block can never be selected if that invariant
+        # ever weakens (mirrors best_moves_rows' `g > _NEG` filter).
+        if int(gains[to]) <= _NEG:
+            return -1, 0
         return to, int(gains[to])
 
     # Border nodes seed the PQ (fm_refiner.cc: shared border-node queue).
@@ -192,11 +199,17 @@ class FMRefiner(Refiner):
             bw = np.bincount(part, weights=node_w, minlength=k).astype(np.int64)
             rng = RandomState.numpy_rng()
 
+            # Connection entries are bounded by a node's incident edge weight,
+            # itself <= the total edge weight — int32 halves the (n, k) buffer
+            # whenever that fits (ADVICE r3 #3).
+            conn_dtype = np.int32 if int(edge_w.sum()) < 2**31 else np.int64
+            conn = np.zeros((g.n, k), dtype=conn_dtype)
+
             total = 0
             for _ in range(self.ctx.num_iterations):
                 delta = _kway_fm_pass(
                     row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw,
-                    k, rng, self.ctx
+                    k, rng, self.ctx, conn
                 )
                 total += delta
                 if delta == 0:

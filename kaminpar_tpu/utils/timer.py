@@ -11,6 +11,7 @@ time whole jitted calls; additionally each scope emits a
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -41,6 +42,7 @@ class Timer:
         self._root = _TimerNode(name)
         self._stack = [self._root]
         self._disabled = 0  # depth counter: parallel sections nest
+        self._disabled_lock = threading.Lock()  # += from pool workers races
         self._t0 = time.perf_counter()
 
     @classmethod
@@ -54,7 +56,8 @@ class Timer:
         cls._global = Timer()
 
     def enable(self) -> None:
-        self._disabled = max(self._disabled - 1, 0)
+        with self._disabled_lock:
+            self._disabled = max(self._disabled - 1, 0)
 
     def disable(self) -> None:
         """Reference disables timers during parallel IP
@@ -62,7 +65,8 @@ class Timer:
         disable/enable nest as a depth counter: an inner parallel section's
         re-enable must not reactivate the (thread-unsafe) scope stack while
         an outer parallel section still has worker threads running."""
-        self._disabled += 1
+        with self._disabled_lock:
+            self._disabled += 1
 
     @contextmanager
     def scope(self, name: str):
