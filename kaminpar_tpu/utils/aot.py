@@ -1,0 +1,311 @@
+"""AOT cross-platform lowering of the kernel set (TPU readiness without TPUs).
+
+The TPU tunnel on the dev box can be down for whole rounds, but kernels must
+not meet the TPU lowering path for the first time on silicon.  This module
+pushes every jitted kernel — shm and the shard_map distributed rounds — through
+``jax.export`` with ``platforms=("tpu",)``, which runs the *platform-specific
+StableHLO lowering rules* (catching unsupported primitives, int64 lowerings,
+degenerate shapes, while-loop/collective issues) without needing a TPU backend.
+What it cannot catch is Mosaic/XLA-TPU *compile*-time failures; those need the
+chip, and ``bench.py`` stays armed for the moment the tunnel works.
+
+Reference counterpart: none — the reference compiles ahead of time by
+construction (C++); this is the JAX equivalent of "it builds for the target".
+
+Usage::
+
+    from kaminpar_tpu.utils.aot import export_kernel_suite
+    sizes = export_kernel_suite(platforms=("tpu",))   # raises on any failure
+
+Exported per kernel: serialized StableHLO bytes (sizes returned for logging).
+``tests/test_tpu_lowering.py`` runs this in CI (VERDICT r3 next-steps #2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+
+class AotExportError(RuntimeError):
+    """One or more kernels failed to lower for the target platform."""
+
+    def __init__(self, failures: Dict[str, str]):
+        self.failures = failures
+        lines = "\n".join(f"  {k}: {v}" for k, v in failures.items())
+        super().__init__(f"{len(failures)} kernel(s) failed to lower:\n{lines}")
+
+
+def _export_one(results, failures, name, fn, *args, platforms, **kwargs):
+    try:
+        exp = jax_export.export(fn, platforms=list(platforms))(*args, **kwargs)
+        results[name] = len(exp.mlir_module_serialized)
+    except Exception as e:  # noqa: BLE001 — collect every failure, then raise
+        failures[name] = f"{type(e).__name__}: {e}"
+
+
+def _shm_suite(results, failures, platforms, *, use_64bit: bool = False):
+    from ..coarsening.hem_clusterer import _hem_round
+    from ..coarsening.lp_clusterer import _intersect_clusterings
+    from ..graph import generators
+    from ..graph.bucketed import build_bucketed_view
+    from ..graph.metrics import _block_weights, _edge_cut
+    from ..ops import lp
+    from ..ops.coloring import color_graph
+    from ..ops.contraction import _contract_device, project_partition
+    from ..refinement.balancer import _balance_round, _underload_round
+    from ..refinement.jet import _jet_move_round
+
+    sfx = "_x64" if use_64bit else ""
+    g = generators.rmat_graph(8, 8, seed=3, use_64bit=use_64bit)
+    pv = g.padded()
+    bv = g.bucketed()
+    k = 8
+    idt = pv.row_ptr.dtype
+    key = jax.random.key(0)
+    n_pad = pv.n_pad
+
+    labels = jnp.concatenate(
+        [jnp.arange(pv.n, dtype=idt), jnp.full(n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+    state = lp.init_state(labels, pv.node_w, n_pad)
+    max_w = jnp.asarray(1 << 20, dtype=idt)
+
+    _export_one(
+        results, failures, f"lp_init_state{sfx}", lp.init_state,
+        labels, pv.node_w, num_labels=n_pad, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"lp_round_flat{sfx}", lp.lp_round,
+        state, key, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w, max_w,
+        num_labels=n_pad, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"lp_round_bucketed{sfx}", lp.lp_round_bucketed,
+        state, key, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_w,
+        num_labels=n_pad, platforms=platforms,
+    )
+    # Fused multi-round while-loop — the clustering hot path.
+    _export_one(
+        results, failures, f"lp_iterate_bucketed{sfx}", lp.lp_iterate_bucketed,
+        state, key, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_w,
+        jnp.int32(1), jnp.int32(5), num_labels=n_pad, active_prob=0.5,
+        platforms=platforms,
+    )
+    # Non-empty heavy part (degree > max_width): the flat two-phase analog.
+    bv_heavy = build_bucketed_view(
+        np.asarray(g.row_ptr), np.asarray(g.col_idx), np.asarray(g.edge_w),
+        g.n, pv.anchor, max_width=16,
+    )
+    _export_one(
+        results, failures, f"lp_round_bucketed_heavy{sfx}", lp.lp_round_bucketed,
+        state, key, bv_heavy.buckets, bv_heavy.heavy, bv_heavy.gather_idx,
+        pv.node_w, max_w, num_labels=n_pad, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"lp_cluster_isolated{sfx}", lp.cluster_isolated_nodes,
+        state, pv.row_ptr, pv.node_w, max_w, num_labels=n_pad,
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"lp_two_hop_bucketed{sfx}",
+        lp.cluster_two_hop_nodes_bucketed,
+        state, key, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_w,
+        num_labels=n_pad, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"intersect_clusterings{sfx}", _intersect_clusterings,
+        labels, labels, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"contraction{sfx}", _contract_device,
+        labels, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"project_partition{sfx}", project_partition,
+        jnp.zeros(g.n, dtype=idt), jnp.zeros(64, dtype=jnp.int32),
+        platforms=platforms,
+    )
+
+    part = jnp.zeros(n_pad, dtype=jnp.int32)
+    max_bw = jnp.full((k,), 1 << 20, dtype=pv.node_w.dtype)
+    min_bw = jnp.zeros((k,), dtype=pv.node_w.dtype)
+    locked = jnp.zeros(n_pad, dtype=bool)
+    _export_one(
+        results, failures, f"jet_move_round{sfx}", _jet_move_round,
+        key, part, locked, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w,
+        max_bw, jnp.float32(0.25), k=k, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"balance_round{sfx}", _balance_round,
+        key, part, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_bw,
+        k=k, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"underload_round{sfx}", _underload_round,
+        key, part, bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_bw,
+        min_bw, k=k, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"color_graph{sfx}", color_graph,
+        key, pv.edge_u, pv.col_idx, pv.node_w > 0, n=n_pad,
+        platforms=platforms,
+    )
+    match0 = jnp.arange(n_pad, dtype=idt)
+    _export_one(
+        results, failures, f"hem_round{sfx}", _hem_round,
+        key, match0, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w, max_w,
+        n_pad=n_pad, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"metrics_block_weights{sfx}", _block_weights,
+        part, pv.node_w, k=k, platforms=platforms,
+    )
+    _export_one(
+        results, failures, f"metrics_edge_cut{sfx}", _edge_cut,
+        pv.edge_u, pv.col_idx, pv.edge_w, part, platforms=platforms,
+    )
+
+
+def _dist_suite(results, failures, platforms, mesh):
+    from ..dist import distribute_graph
+    from ..dist.balancer import (
+        make_dist_balance_round,
+        make_dist_cluster_balance_round,
+    )
+    from ..dist.contraction import _s1, _s4, next_pow2
+    from ..dist.jet import make_dist_jet_round
+    from ..dist.lp import (
+        make_dist_clp_round,
+        make_dist_cluster_round,
+        make_dist_coloring,
+        make_dist_lp_round,
+        make_dist_lp_round_best,
+    )
+    from ..graph import generators
+
+    P = mesh.size
+    g = generators.grid2d_graph(16, 16)
+    dg = distribute_graph(g, P)
+    k = 8
+    key = jax.random.key(0)
+    labels = jnp.zeros(dg.N, jnp.int32)
+    max_w = jnp.full((k,), 1 << 20, jnp.int32)
+    common = (dg.node_w, dg.edge_u, dg.col_loc, dg.edge_w)
+    routing = (dg.send_idx, dg.recv_map)
+
+    _export_one(
+        results, failures, "dist_lp_round",
+        make_dist_lp_round(mesh, num_labels=k),
+        key, labels, *common, max_w, *routing, jnp.int32(0), jnp.int32(0),
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, "dist_lp_round_chunked",
+        make_dist_lp_round(mesh, num_labels=k, num_chunks=8),
+        key, labels, *common, max_w, *routing, jnp.int32(0), jnp.int32(0),
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, "dist_lp_round_best",
+        make_dist_lp_round_best(mesh, num_labels=k),
+        key, labels, *common, max_w, *routing, platforms=platforms,
+    )
+    cap_q = min(next_pow2(max(64, 2 * dg.n_loc // P), 8), dg.n_loc)
+    clabels = jnp.arange(dg.N, dtype=jnp.int32)
+    cmax_w = jnp.asarray(1 << 20, jnp.int32)
+    _export_one(
+        results, failures, "dist_cluster_round",
+        make_dist_cluster_round(mesh, cap_q=cap_q),
+        key, clabels, *common, cmax_w, *routing, platforms=platforms,
+    )
+    colors0 = jnp.where(jnp.arange(dg.N) < dg.n, jnp.int32(-1), jnp.int32(0))
+    _export_one(
+        results, failures, "dist_coloring",
+        make_dist_coloring(mesh),
+        colors0, dg.edge_u, dg.col_loc, dg.edge_w, *routing,
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, "dist_clp_round",
+        make_dist_clp_round(mesh, num_labels=k),
+        key, labels, jnp.zeros(dg.N, jnp.int32), jnp.int32(0), *common,
+        max_w, *routing, platforms=platforms,
+    )
+    locked = jnp.zeros(dg.N, dtype=bool)
+    _export_one(
+        results, failures, "dist_jet_round",
+        make_dist_jet_round(mesh, num_labels=k),
+        key, labels, locked, *common, max_w, *routing, jnp.float32(0.25),
+        platforms=platforms,
+    )
+    _export_one(
+        results, failures, "dist_balance_round",
+        make_dist_balance_round(mesh, k=k),
+        key, labels, *common, max_w, *routing, platforms=platforms,
+    )
+    _export_one(
+        results, failures, "dist_cluster_balance_round",
+        make_dist_cluster_balance_round(mesh, k=k),
+        key, labels, *common, max_w, *routing, platforms=platforms,
+    )
+    # Dist contraction stages S1 (owner aggregation) and S4 (compaction).
+    # S2/S3's risky primitives (owner_query routing, dense all_to_all +
+    # multi-operand lax.sort) are covered by dist_cluster_round above.
+    _export_one(
+        results, failures, "dist_contract_s1", _s1,
+        mesh, clabels, dg.node_w, n_loc=dg.n_loc, cap_q=cap_q,
+        platforms=platforms,
+    )
+    m_loc_c = max(dg.m_loc // 2, 1)
+    _export_one(
+        results, failures, "dist_contract_s4", _s4,
+        mesh, dg.edge_u, dg.col_loc, dg.edge_w, m_loc_c=m_loc_c,
+        platforms=platforms,
+    )
+
+
+def export_kernel_suite(
+    platforms: Iterable[str] = ("tpu",),
+    *,
+    include_dist: bool = True,
+    include_x64: bool = True,
+    mesh=None,
+) -> Dict[str, int]:
+    """Export every kernel for the target platform(s); returns name -> bytes.
+
+    Raises :class:`AotExportError` listing every kernel that failed to lower.
+    ``mesh`` defaults to an 8-device mesh over the available devices (tests
+    force 8 CPU devices; the mesh's platform does not constrain the export
+    target — lowering is cross-platform).
+    """
+    results: Dict[str, int] = {}
+    failures: Dict[str, str] = {}
+    platforms = tuple(platforms)
+
+    _shm_suite(results, failures, platforms)
+    if include_x64:
+        # The 64-bit mode (reference: KAMINPAR_64BIT_* switches) changes every
+        # sort/segment dtype — int64 lowerings are a classic TPU divergence.
+        with jax.enable_x64(True):
+            _shm_suite(results, failures, platforms, use_64bit=True)
+    if include_dist:
+        if mesh is None:
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            if len(devs) >= 8:
+                mesh = Mesh(np.array(devs[:8]), ("nodes",))
+        if mesh is not None:
+            _dist_suite(results, failures, platforms, mesh)
+        else:
+            failures["dist_suite"] = "need >= 8 devices for the dist mesh"
+
+    if failures:
+        raise AotExportError(failures)
+    return results

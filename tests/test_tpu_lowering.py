@@ -1,0 +1,50 @@
+"""AOT TPU lowering of the full kernel set (VERDICT r3 next-steps #2).
+
+The TPU tunnel can be down for a whole round; this test guarantees every
+kernel — shm, 64-bit variants, and the shard_map distributed rounds on the
+8-device mesh — lowers cleanly through ``jax.export`` for ``platforms=['tpu']``
+so first silicon contact measures instead of debugging.  Lowering-rule
+failures (unsupported primitives, int64 sorts, degenerate shapes, collectives)
+surface here; Mosaic/XLA-TPU compile-time failures still need the chip.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kaminpar_tpu.utils.aot import AotExportError, export_kernel_suite
+
+
+def test_kernel_suite_lowers_for_tpu():
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8]), ("nodes",)) if len(devs) >= 8 else None
+    try:
+        sizes = export_kernel_suite(
+            platforms=("tpu",), include_dist=mesh is not None, mesh=mesh
+        )
+    except AotExportError as e:
+        pytest.fail(str(e))
+    # Full sweep: shm + x64 variants (+ dist rounds when the mesh exists).
+    assert len(sizes) >= 32, sorted(sizes)
+    assert all(n > 0 for n in sizes.values())
+    # Spot-check the headline kernels are present.
+    for name in (
+        "lp_iterate_bucketed",
+        "lp_round_bucketed_heavy",
+        "contraction",
+        "jet_move_round",
+        "balance_round",
+        "lp_iterate_bucketed_x64",
+        "contraction_x64",
+    ):
+        assert name in sizes
+    if mesh is not None:
+        for name in (
+            "dist_lp_round",
+            "dist_cluster_round",
+            "dist_coloring",
+            "dist_jet_round",
+            "dist_contract_s1",
+        ):
+            assert name in sizes
