@@ -32,7 +32,13 @@ import numpy as np
 
 MIN_WIDTH = 8
 MAX_WIDTH = 4096  # batched row sorts stay cheap even at this width
-MIN_ROWS = 4096  # buckets with fewer rows merge upward to bound recompiles
+# Buckets with fewer rows merge upward to bound the per-level kernel-shape
+# count.  4096 was far too aggressive: on power-law graphs it cascaded every
+# mid-degree class into one max-width bucket (rmat14: 73x slot inflation,
+# 15x slower LP rounds on TPU).  Bucket *count* barely affects XLA compile
+# time (row sorts are cheap to compile; measured 19 s for 2 buckets vs 19 s
+# for 6); padding waste dominates runtime, so keep classes fine-grained.
+MIN_ROWS = 256
 
 
 class Bucket(NamedTuple):

@@ -78,12 +78,17 @@ def create_strong_context() -> Context:
     mapping; JET is the TPU-native quality refiner, SURVEY §7 stage 7)."""
     ctx = create_eco_context()
     ctx.preset_name = "strong"
+    # JET runs *before* FM so the monotone positive-gain hill-climber is the
+    # last quality refiner: JET's temperature-admitted negative moves open new
+    # basins and FM then only descends (round-3 measured the reverse order
+    # inverting the tier ladder on rgg64k — JET admitted moves FM would not,
+    # and nothing after it cleaned them up; see QUALITY_NOTES.md).
     ctx.refinement.algorithms = (
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.LP,
-        RefinementAlgorithm.KWAY_FM,
-        RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.JET,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.KWAY_FM,
         RefinementAlgorithm.OVERLOAD_BALANCER,
         RefinementAlgorithm.UNDERLOAD_BALANCER,
     )

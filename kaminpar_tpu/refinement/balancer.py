@@ -7,7 +7,7 @@ until the block is feasible.  The TPU version runs bulk-synchronous rounds:
 1. every node in an overloaded block computes its best feasible external
    target (highest connection; fallback: the globally lightest block),
 2. per *source* block, movers are admitted in decreasing relative-gain order
-   until the overload is covered (sort + segmented prefix sum),
+   until the overload is covered (per-block gain-threshold bisection),
 3. per *target* block, admitted movers pass a strict capacity auction
    (same pattern as ops/lp.py) so no receiver becomes overloaded.
 
@@ -24,7 +24,6 @@ import jax.numpy as jnp
 from ..context import BalancerContext
 from ..graph.partitioned import PartitionedGraph
 from ..ops.bucketed_gains import bucketed_best_moves
-from ..ops.segment import run_starts, segment_prefix_sum
 from ..utils import next_key
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
@@ -78,25 +77,64 @@ def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k
 
 
 def _admit_by_budget(mask, block_of, rel, node_w, budget, k: int, *, inclusive: bool):
-    """Per-block greedy admission: sort candidates of each block by
-    decreasing relative gain and keep the prefix whose cumulative weight
-    fits the block's budget (exclusive: admit while already-admitted weight
-    is still below the budget; inclusive: admit only if the move itself
-    still fits).  Shared by both balancers."""
+    """Per-block greedy admission by decreasing relative gain.
+
+    Sort-free: bisect a per-block gain threshold (24 rounds of masked
+    segment-sums) to the lowest value whose admitted weight still fits the
+    block's budget — the 1D lexsort this replaces was ~10 s of XLA compile
+    per shape on TPU (1D sort stages unroll; row sorts don't), and this
+    kernel sits inside every balancer round.  The random jitter already
+    added to ``rel`` by the callers makes gain ties measure-zero, so the
+    threshold set matches the sorted prefix up to float32 resolution.
+
+    inclusive: admitted weight never exceeds the budget (strict cap — used
+    target-side).  exclusive: reference PQ semantics admit moves while the
+    budget is uncovered, letting the final move overshoot
+    (overload_balancer.cc pushes until feasible); the bisection
+    under-admits, so the single best still-pending candidate per uncovered
+    block is force-admitted to guarantee coverage progress."""
     n = mask.shape[0]
-    blk = jnp.where(mask, block_of, k)
-    order = jnp.lexsort((-rel, blk))
-    b_s = blk[order]
-    w_s = jnp.where(mask[order], node_w[order], 0)
-    first = run_starts(b_s)
-    prefix = segment_prefix_sum(w_s, first)
-    valid = b_s < k
-    b_idx = jnp.where(valid, b_s, 0)
-    if inclusive:
-        keep = valid & (prefix <= budget[b_idx])
-    else:
-        keep = valid & (prefix - w_s < budget[b_idx])
-    return jnp.zeros(n, dtype=bool).at[order].set(keep)
+    b_idx = jnp.where(mask, block_of, 0)
+    w = jnp.where(mask, node_w, 0)
+    relf = rel.astype(jnp.float32)
+    neg = jnp.float32(-3.4e38)
+    pos = jnp.float32(3.4e38)
+    rel_lo = jnp.where(mask, relf, pos)
+    rel_hi = jnp.where(mask, relf, neg)
+    lo = jax.ops.segment_min(rel_lo, b_idx, num_segments=k)  # admit-all end
+    hi = jax.ops.segment_max(rel_hi, b_idx, num_segments=k) + 1.0  # admit-none
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        adm = mask & (relf >= mid[b_idx])
+        demand = jax.ops.segment_sum(jnp.where(adm, w, 0), b_idx, num_segments=k)
+        fits = demand <= budget
+        return jnp.where(fits, lo, mid), jnp.where(fits, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, 24, body, (lo, hi))
+    # float32 can leave hi one ulp above lo forever; if the admit-all end
+    # fits the budget, use it (the common uncontended case must admit all).
+    adm_lo = mask & (relf >= lo[b_idx])
+    d_lo = jax.ops.segment_sum(jnp.where(adm_lo, w, 0), b_idx, num_segments=k)
+    thr = jnp.where(d_lo <= budget, lo, hi)
+    admitted = mask & (relf >= thr[b_idx])
+    if not inclusive:
+        adm_w = jax.ops.segment_sum(
+            jnp.where(admitted, w, 0), b_idx, num_segments=k
+        )
+        uncovered = adm_w < budget
+        pend = mask & ~admitted & uncovered[b_idx]
+        best = jax.ops.segment_max(
+            jnp.where(pend, relf, neg), b_idx, num_segments=k
+        )
+        cand = pend & (relf == best[b_idx])
+        idx = jnp.arange(n, dtype=jnp.int32)
+        first_idx = jax.ops.segment_min(
+            jnp.where(cand, idx, n), b_idx, num_segments=k
+        )
+        admitted = admitted | (cand & (idx == first_idx[b_idx]))
+    return admitted
 
 
 @partial(jax.jit, static_argnames=("k",))

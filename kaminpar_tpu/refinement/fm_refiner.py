@@ -9,8 +9,9 @@ different: the scalable quality refiner is JET (bulk-synchronous, device)
 and FM's role is squeezing the remaining few percent on the *small* levels
 of the hierarchy, where a sequential host pass is cheap.  So this is a
 global k-way FM with lazy-revalidation PQ and best-prefix rollback (the
-classic algorithm the reference localizes), gated by ``max_n`` /
-``max_nk`` — a documented divergence, not a translation.
+classic algorithm the reference localizes), gated by ``max_n`` (a
+wall-time bound on the sequential pass) — a documented divergence, not a
+translation.
 
 Round-3 redesign (VERDICT r2 weak #3 / next-steps #4): the per-node
 ``best_move`` dict loop is replaced by a dense (n, k) block-connection
@@ -20,6 +21,15 @@ from u into block b.  Seeding, revalidation and neighbor re-push all become
 NumPy row operations; a move updates only its neighbors' rows
 (``np.add.at``).  Measured ~40x over the round-2 dict loop at n=65k,
 which is what lets the gate rise from 131k to 1M nodes.
+
+Round 4 (VERDICT r3 next #6): above ``dense_nk_threshold`` connection
+entries the dense matrix is replaced by a lazily-materialized *border-row
+table* — the role of the reference's sparse/compact-hashing gain caches
+(``refinement/gains/sparse_gain_cache.h:538``): only nodes FM actually
+touches (border seeds + neighbors of moved nodes) get a k-wide connection
+row, built on first touch from the live partition and updated
+incrementally afterwards.  Memory scales with the active set, not n*k, so
+the n*k gate is gone and eco survives e.g. n=4M / k=16 (BASELINE config 2).
 
 Semantics kept from the reference:
 - adaptive (Osipov/Sanders) stopping: abort a pass after
@@ -42,20 +52,111 @@ from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
 
+class _DenseConn:
+    """Dense (n, k) connection matrix (dense_gain_cache.h analog)."""
+
+    def __init__(self, n: int, k: int, dtype):
+        self.k = k
+        self.buf = np.zeros((n, k), dtype=dtype)
+        self.dtype = dtype
+
+    def reset(self, row_ptr, col_idx, edge_w, u_arr, part):
+        self.buf.fill(0)
+        np.add.at(self.buf, (u_arr, part[col_idx]), edge_w)
+
+    def get_rows(self, nodes, part):
+        return self.buf[nodes]
+
+    def get_row(self, u, part):
+        return self.buf[u]
+
+    def add(self, nbrs, block, ws):
+        np.add.at(self.buf, (nbrs, block), ws)
+
+
+class _ConnBudgetExceeded(Exception):
+    """Raised when the sparse table would outgrow its entry budget; the
+    pass ends early (keeping its best prefix) instead of the host OOMing."""
+
+
+class _SparseConn:
+    """Lazily-materialized border-row connection table.
+
+    The reference avoids the O(n*k) dense cache at scale with sparse /
+    compact-hashing gain caches (sparse_gain_cache.h:538); the NumPy
+    rendition: ``slot_of[u]`` maps a touched node to a row in a growable
+    (cap, k) table.  A row is built on first touch from the *live*
+    partition (O(deg + k)) and updated incrementally afterwards, which
+    keeps it consistent with the dense variant's "initial + all deltas"
+    value.  Untouched nodes cost nothing; ``max_entries`` bounds the table
+    (a near-all-border level would otherwise rebuild the dense blow-up the
+    sparse path exists to avoid), ending the pass via
+    :class:`_ConnBudgetExceeded` when the active set outgrows it."""
+
+    def __init__(self, n: int, k: int, dtype, row_ptr, col_idx, edge_w,
+                 max_entries: int = 1 << 28):
+        self.k = k
+        self.dtype = dtype
+        self.slot_of = np.full(n, -1, dtype=np.int64)
+        cap = 1024
+        self.rows = np.zeros((cap, k), dtype=dtype)
+        self.used = 0
+        self.max_rows = max(max_entries // max(k, 1), 1024)
+        self.row_ptr = row_ptr
+        self.col_idx = col_idx
+        self.edge_w = edge_w
+
+    def reset(self, row_ptr, col_idx, edge_w, u_arr, part):
+        self.slot_of.fill(-1)
+        self.used = 0
+
+    def _ensure(self, nodes, part):
+        new = nodes[self.slot_of[nodes] < 0]
+        if len(new) == 0:
+            return
+        new = np.unique(new)
+        need = self.used + len(new)
+        if need > self.max_rows:
+            raise _ConnBudgetExceeded
+        if need > self.rows.shape[0]:
+            cap = min(max(need, 2 * self.rows.shape[0]), self.max_rows)
+            self.rows = np.resize(self.rows, (cap, self.k))
+        degs = (self.row_ptr[new + 1] - self.row_ptr[new]).astype(np.int64)
+        total = int(degs.sum())
+        starts = self.row_ptr[new]
+        base = np.repeat(starts - np.concatenate([[0], np.cumsum(degs)[:-1]]), degs)
+        idx = base + np.arange(total, dtype=np.int64)
+        rloc = np.repeat(np.arange(len(new), dtype=np.int64), degs)
+        tmp = np.zeros((len(new), self.k), dtype=self.dtype)
+        np.add.at(tmp, (rloc, part[self.col_idx[idx]]), self.edge_w[idx])
+        self.rows[self.used : self.used + len(new)] = tmp
+        self.slot_of[new] = np.arange(self.used, self.used + len(new))
+        self.used += len(new)
+
+    def get_rows(self, nodes, part):
+        self._ensure(nodes, part)
+        return self.rows[self.slot_of[nodes]]
+
+    def get_row(self, u, part):
+        s = self.slot_of[u]
+        if s < 0:
+            self._ensure(np.asarray([u]), part)
+            s = self.slot_of[u]
+        return self.rows[s]
+
+    def add(self, nbrs, block, ws):
+        slots = self.slot_of[nbrs]
+        m = slots >= 0
+        if m.any():
+            np.add.at(self.rows, (slots[m], block), ws[m])
+
+
 def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, rng, ctx, conn):
     """One FM pass; mutates part/bw in place, returns the cut delta (<= 0)."""
     n = len(row_ptr) - 1
     _NEG = np.iinfo(conn.dtype).min // 2
 
-    # Dense block-connection matrix: C[u, b] = sum of edge weights from u
-    # into block b (the reference's dense gain cache, dense_gain_cache.h).
-    # The buffer is allocated once in refine() (int32 when total edge weight
-    # permits) and reset here — at the max_nk gate a fresh int64 allocation
-    # would be 512 MiB per pass (ADVICE r3 #3).
-    conn.fill(0)
-    np.add.at(conn, (u_arr, part[col_idx]), edge_w)
-
-    cols = np.arange(k)
+    conn.reset(row_ptr, col_idx, edge_w, u_arr, part)
 
     def best_moves_rows(nodes):
         """Vectorized best feasible move per node: (to, gain) arrays.
@@ -63,7 +164,7 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
         Targets must be adjacent (connection > 0, matching the reference's
         iteration over rating-map entries), not the own block, and fit the
         target block's weight budget."""
-        rows = conn[nodes]  # (b, k)
+        rows = conn.get_rows(nodes, part)  # (b, k)
         own = part[nodes]
         internal = rows[np.arange(len(nodes)), own]
         w = node_w[nodes]
@@ -77,7 +178,7 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
 
     def best_move(u):
         """Scalar fast path of best_moves_rows (per-pop revalidation)."""
-        row = conn[u]
+        row = conn.get_row(u, part)
         own = part[u]
         w_u = node_w[u]
         valid = (row > 0) & (bw + w_u <= max_bw)
@@ -99,17 +200,6 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
     np.logical_or.at(border_mask, u_arr, part[u_arr] != part[col_idx])
     border = np.flatnonzero(border_mask)
 
-    heap = []
-    if len(border):
-        tos, gains = best_moves_rows(border)
-        ok = tos >= 0
-        prios = rng.integers(1 << 30, size=int(ok.sum()))
-        heap = [
-            (-int(g), int(p), int(u), int(t))
-            for u, t, g, p in zip(border[ok], tos[ok], gains[ok], prios)
-        ]
-    heapq.heapify(heap)
-
     locked = np.zeros(n, dtype=bool)
     moves: list = []  # (u, from)
     cur_delta = 0
@@ -118,50 +208,67 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
     fruitless = 0
     max_fruitless = max(ctx.num_fruitless_moves, int(ctx.alpha * np.sqrt(n)))
 
-    while heap and fruitless < max_fruitless:
-        neg_gain, _, u, to = heapq.heappop(heap)
-        if locked[u]:
-            continue
-        # Lazy revalidation (reference: compute_best_gain on pop).
-        cur_to, cur_gain = best_move(u)
-        if cur_to < 0:
-            continue
-        if cur_to != to or -neg_gain != cur_gain:
-            heapq.heappush(heap, (-cur_gain, int(rng.integers(1 << 30)), u, cur_to))
-            continue
-
-        src = part[u]
-        w_u = int(node_w[u])
-        part[u] = cur_to
-        bw[src] -= w_u
-        bw[cur_to] += w_u
-        locked[u] = True
-        moves.append((u, src))
-        cur_delta -= cur_gain
-        if cur_delta < best_delta:
-            best_delta = cur_delta
-            best_prefix = len(moves)
-            fruitless = 0
-        else:
-            fruitless += 1
-
-        # u moved src -> cur_to: each neighbor's connection row shifts by
-        # the connecting edge weight; then re-push the unlocked neighbors
-        # with their (vectorized) new best moves.
-        s, e = row_ptr[u], row_ptr[u + 1]
-        nbrs = col_idx[s:e]
-        ws = edge_w[s:e]
-        np.add.at(conn, (nbrs, src), -ws)
-        np.add.at(conn, (nbrs, cur_to), ws)
-        live = nbrs[~locked[nbrs]]
-        if len(live):
-            live = np.unique(live)
-            tos, gains = best_moves_rows(live)
+    try:
+        heap = []
+        if len(border):
+            tos, gains = best_moves_rows(border)
             ok = tos >= 0
-            for v, t, g in zip(live[ok], tos[ok], gains[ok]):
-                heapq.heappush(
-                    heap, (-int(g), int(rng.integers(1 << 30)), int(v), int(t))
-                )
+            prios = rng.integers(1 << 30, size=int(ok.sum()))
+            heap = [
+                (-int(g), int(p), int(u), int(t))
+                for u, t, g, p in zip(border[ok], tos[ok], gains[ok], prios)
+            ]
+        heapq.heapify(heap)
+
+        while heap and fruitless < max_fruitless:
+            neg_gain, _, u, to = heapq.heappop(heap)
+            if locked[u]:
+                continue
+            # Lazy revalidation (reference: compute_best_gain on pop).
+            cur_to, cur_gain = best_move(u)
+            if cur_to < 0:
+                continue
+            if cur_to != to or -neg_gain != cur_gain:
+                heapq.heappush(heap, (-cur_gain, int(rng.integers(1 << 30)), u, cur_to))
+                continue
+
+            src = part[u]
+            w_u = int(node_w[u])
+            part[u] = cur_to
+            bw[src] -= w_u
+            bw[cur_to] += w_u
+            locked[u] = True
+            moves.append((u, src))
+            cur_delta -= cur_gain
+            if cur_delta < best_delta:
+                best_delta = cur_delta
+                best_prefix = len(moves)
+                fruitless = 0
+            else:
+                fruitless += 1
+
+            # u moved src -> cur_to: each neighbor's connection row shifts by
+            # the connecting edge weight; then re-push the unlocked neighbors
+            # with their (vectorized) new best moves.
+            s, e = row_ptr[u], row_ptr[u + 1]
+            nbrs = col_idx[s:e]
+            ws = edge_w[s:e]
+            conn.add(nbrs, src, -ws)
+            conn.add(nbrs, cur_to, ws)
+            live = nbrs[~locked[nbrs]]
+            if len(live):
+                live = np.unique(live)
+                tos, gains = best_moves_rows(live)
+                ok = tos >= 0
+                for v, t, g in zip(live[ok], tos[ok], gains[ok]):
+                    heapq.heappush(
+                        heap, (-int(g), int(rng.integers(1 << 30)), int(v), int(t))
+                    )
+    except _ConnBudgetExceeded:
+        # Active set outgrew the sparse table's entry budget: end the pass
+        # here and keep its best prefix (the dense-matrix blow-up this
+        # bounds is exactly what the old max_nk gate prevented).
+        pass
 
     # Roll back to the best prefix (connection rows are rebuilt next pass,
     # so only part/bw must be restored).
@@ -179,20 +286,24 @@ class FMRefiner(Refiner):
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         g = p_graph.graph
-        if g.n > self.ctx.max_n or g.n * p_graph.k > self.ctx.max_nk:
+        if g.n > self.ctx.max_n:
             Logger.log(
-                f"  fm: skipped (n={g.n}, n*k={g.n * p_graph.k} exceeds "
-                f"max_n={self.ctx.max_n}/max_nk={self.ctx.max_nk}; JET is "
-                "the at-scale quality refiner)",
+                f"  fm: skipped (n={g.n} exceeds max_n={self.ctx.max_n}; "
+                "JET is the at-scale quality refiner)",
                 OutputLevel.DEBUG,
             )
             return p_graph
         with scoped_timer("fm_refinement"):
             row_ptr = np.asarray(g.row_ptr).astype(np.int64)
-            col_idx = np.asarray(g.col_idx).astype(np.int64)
-            edge_w = np.asarray(g.edge_w).astype(np.int64)
+            # 32-bit adjacency halves the host footprint at the 4M-node scale
+            # the sparse table exists for (ids and edge weights are 32-bit in
+            # the reference's default build too, CMakeLists.txt:71-79).
+            col_idx = np.asarray(g.col_idx).astype(np.int32, copy=False)
+            ew64 = np.asarray(g.edge_w).astype(np.int64)
+            small_w = int(ew64.sum()) < 2**31
+            edge_w = ew64.astype(np.int32) if small_w else ew64
             node_w = np.asarray(g.node_w).astype(np.int64)
-            u_arr = np.repeat(np.arange(g.n), np.diff(row_ptr))
+            u_arr = np.repeat(np.arange(g.n, dtype=np.int32), np.diff(row_ptr))
             part = np.asarray(p_graph.partition).astype(np.int32).copy()
             max_bw = np.asarray(p_graph.max_block_weights, dtype=np.int64)
             k = p_graph.k
@@ -200,10 +311,13 @@ class FMRefiner(Refiner):
             rng = RandomState.numpy_rng()
 
             # Connection entries are bounded by a node's incident edge weight,
-            # itself <= the total edge weight — int32 halves the (n, k) buffer
+            # itself <= the total edge weight — int32 halves the buffer
             # whenever that fits (ADVICE r3 #3).
-            conn_dtype = np.int32 if int(edge_w.sum()) < 2**31 else np.int64
-            conn = np.zeros((g.n, k), dtype=conn_dtype)
+            conn_dtype = np.int32 if small_w else np.int64
+            if g.n * k <= self.ctx.dense_nk_threshold:
+                conn = _DenseConn(g.n, k, conn_dtype)
+            else:
+                conn = _SparseConn(g.n, k, conn_dtype, row_ptr, col_idx, edge_w)
 
             total = 0
             for _ in range(self.ctx.num_iterations):

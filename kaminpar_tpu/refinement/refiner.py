@@ -18,23 +18,43 @@ class Refiner:
 
 
 class MultiRefiner(Refiner):
+    """Ordered refiner pipeline with keep-best snapshotting.
+
+    The reference's JET snapshooter rolls a refiner back to the best seen
+    partition (refinement/jet/jet_refiner.cc, dist snapshooter.cc); we apply
+    the same guarantee to the *whole chain*: a refinement step never returns
+    a partition worse than its input, where "worse" is lexicographic on
+    (infeasible, edge cut) — a feasible partition always beats an infeasible
+    one, then lower cut wins.  This pins the preset ladder monotone (a
+    temperature-admitted JET excursion that ends badly cannot leak out of the
+    level that made it)."""
+
     def __init__(self, refiners: Sequence[Refiner]):
         self.refiners = list(refiners)
+
+    @staticmethod
+    def _rank(p_graph: PartitionedGraph):
+        return (not p_graph.is_feasible(), p_graph.edge_cut())
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         from ..utils.logger import Logger, OutputLevel
 
         debug = Logger.level.value >= OutputLevel.DEBUG.value
+        best = p_graph
+        best_rank = self._rank(p_graph)
+        prev_cut = best_rank[1]
         for r in self.refiners:
-            if debug:
-                before = p_graph.edge_cut()
             p_graph = r.refine(p_graph)
+            rank = self._rank(p_graph)
             if debug:
                 Logger.log(
-                    f"    {type(r).__name__}: cut {before} -> {p_graph.edge_cut()}",
+                    f"    {type(r).__name__}: cut {prev_cut} -> {rank[1]}",
                     OutputLevel.DEBUG,
                 )
-        return p_graph
+            prev_cut = rank[1]
+            if rank <= best_rank:
+                best, best_rank = p_graph, rank
+        return best
 
 
 class NoopRefiner(Refiner):

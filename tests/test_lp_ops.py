@@ -112,3 +112,32 @@ def test_lp_refinement_mode_small_k():
     assert final_cut < init_cut
     bw = np.asarray(state.label_weights)
     assert bw.max() <= 40 and bw.sum() == 64
+
+
+def test_capacity_auction_strict_and_matches_oracle_uncontended():
+    """The probabilistic auction must (a) never admit past a target's cap —
+    the invariant the sorted-prefix oracle (capacity_auction_sorted) was
+    built for — and (b) admit *everything* the oracle admits in the
+    uncontended case (demand <= slack), so the common path loses nothing."""
+    rng = np.random.default_rng(11)
+    n, L = 512, 16
+    movers = jnp.asarray(rng.random(n) < 0.7)
+    target = jnp.asarray(rng.integers(0, L, n).astype(np.int32))
+    node_w = jnp.asarray(rng.integers(1, 5, n).astype(np.int32))
+    base = jnp.zeros(L, dtype=jnp.int32)
+
+    # (a) contended: tight caps, strictness must hold for both variants.
+    cap = jnp.asarray(np.full(L, 23, dtype=np.int32))
+    for fn in (lp.capacity_auction, lp.capacity_auction_sorted):
+        acc = fn(next_key(), movers, target, node_w, base, cap, L)
+        w = np.where(np.asarray(movers & acc), np.asarray(node_w), 0)
+        per = np.bincount(np.asarray(target), weights=w, minlength=L)
+        assert (per <= 23).all(), fn.__name__
+
+    # (b) uncontended: both admit every mover.
+    wide = jnp.asarray(np.full(L, 10**6, dtype=np.int32))
+    key = next_key()
+    acc_p = lp.capacity_auction(key, movers, target, node_w, base, wide, L)
+    acc_s = lp.capacity_auction_sorted(key, movers, target, node_w, base, wide, L)
+    assert bool(jnp.all((movers & acc_p) == movers))
+    assert bool(jnp.all((movers & acc_s) == movers))
