@@ -81,11 +81,6 @@ def run_benchmark() -> None:
     backend = dev.platform
     device_kind = getattr(dev, "device_kind", backend)
     on_accel = backend != "cpu"
-    if not on_accel:
-        # CPU path: the persistent-cache executable serializer is the known
-        # crasher (see kaminpar_tpu/__init__); a benchmark must never die
-        # writing a cache.
-        jax.config.update("jax_compilation_cache_dir", None)
 
     default_scale = 22 if on_accel else 16
     scale = int(os.environ.get("KPTPU_BENCH_SCALE", default_scale))

@@ -13,13 +13,12 @@ import jax as _jax
 
 # Persistent XLA compilation cache: multilevel runs hit a bounded set of
 # power-of-2 kernel shapes (see graph/csr.py PaddedView); caching them on disk
-# makes every run after the first start hot.  Override dir or disable via env.
-# DISABLED by default on the CPU backend: jaxlib's executable serializer
-# intermittently crashes (SIGSEGV/SIGABRT) inside put_executable_and_time
-# there; tests force it off (tests/conftest.py), and a JAX_PLATFORMS=cpu
-# environment defaults it off too.
-_default_no_cache = "1" if _os.environ.get("JAX_PLATFORMS", "") == "cpu" else "0"
-if _os.environ.get("KAMINPAR_TPU_NO_CACHE", _default_no_cache) != "1":
+# makes every run after the first start hot (measured 6.4x on a full CPU
+# partition, round 4).  Enabled on every backend; the round-3 CPU
+# serializer crashes traced to AOT executable caching, which stays off via
+# jax_persistent_cache_enable_xla_caches="none" below.  Override dir or
+# disable via env.
+if _os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") != "1":
     _cache_dir = _os.environ.get(
         "KAMINPAR_TPU_CACHE_DIR",
         _os.path.join(_os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla"),

@@ -274,9 +274,10 @@ class DeepMultilevelPartitioner:
                 graph = coarsener.current_graph
                 target_k = compute_k_for_n(graph.n, C, k) if coarsener.num_levels > 0 else k
                 if cur_k < target_k:
-                    part = extend_partition(
-                        graph, np.asarray(p_graph.partition), cur_k, target_k, ctx
-                    )
+                    with scoped_timer("extend_partition"):
+                        part = extend_partition(
+                            graph, np.asarray(p_graph.partition), cur_k, target_k, ctx
+                        )
                     if debug:
                         from ..graph import metrics as _m
 

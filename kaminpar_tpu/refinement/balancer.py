@@ -55,8 +55,11 @@ def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k
     # Relative gain orders cheap high-gain moves first (reference scales gain
     # by node weight; a float ratio gives the same ordering intent).
     rel = gain.astype(jnp.float32) / jnp.maximum(node_w, 1).astype(jnp.float32)
+    # Tie-break jitter scaled to the gain magnitude so it stays above one
+    # float32 ulp even when |rel| is large (a fixed 1e-3 vanishes beyond
+    # |rel| ~ 8192, collapsing the threshold bisection to all-or-none).
     jitter = jax.random.uniform(ks, (n,), minval=0.0, maxval=1e-3)
-    rel = rel + jitter
+    rel = rel + jitter * jnp.maximum(jnp.abs(rel), 1.0)
 
     # --- source-side admission: cover each block's overload ---------------
     overload = jnp.maximum(block_weights - max_bw, 0)
@@ -179,7 +182,8 @@ def _underload_round(
 
     gain = tconn - oconn
     rel = gain.astype(jnp.float32) / jnp.maximum(node_w, 1).astype(jnp.float32)
-    rel = rel + jax.random.uniform(ks, (n,), minval=0.0, maxval=1e-3)
+    jit2 = jax.random.uniform(ks, (n,), minval=0.0, maxval=1e-3)
+    rel = rel + jit2 * jnp.maximum(jnp.abs(rel), 1.0)  # see _balance_round
 
     # --- donor-side admission: never drop a donor below its minimum -------
     src_ok = _admit_by_budget(eligible, labels, rel, node_w, surplus, k, inclusive=True)

@@ -34,7 +34,11 @@ class MultiRefiner(Refiner):
 
     @staticmethod
     def _rank(p_graph: PartitionedGraph):
-        return (not p_graph.is_feasible(), p_graph.edge_cut())
+        # Feasibility covers both weight bounds: max (overload) and, when
+        # configured, min (underload) — otherwise keep-best would roll back
+        # the underload balancer's cut-raising moves as "worse".
+        infeasible = not (p_graph.is_feasible() and p_graph.is_min_feasible())
+        return (infeasible, p_graph.edge_cut())
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         from ..utils.logger import Logger, OutputLevel
