@@ -25,18 +25,23 @@ if _os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") != "1":
     )
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # The AOT-executable guard must be configured BEFORE the cache dir
+        # goes live: jaxlib's executable serializer intermittently
+        # SIGSEGV/SIGABRTs inside put_executable_and_time on the CPU
+        # backend (observed crashing the test suite from two different
+        # kernels), and cross-machine AOT artifacts reload with
+        # machine-feature mismatches.  Caching the HLO/compilation only
+        # keeps most of the warm-start benefit; if this option is missing
+        # (older jax), the except below leaves the cache fully disabled.
+        _jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        # Do NOT let the persistent cache serialize XLA:CPU AOT executables:
-        # jaxlib's serializer intermittently SIGSEGV/SIGABRTs inside
-        # put_executable_and_time on this backend (observed crashing the
-        # test suite from two different kernels), and cross-machine AOT
-        # artifacts also reload with machine-feature mismatches.  Caching
-        # the HLO/compilation only keeps most of the warm-start benefit.
-        _jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
     except Exception:  # pragma: no cover — cache is an optimization only
-        pass
+        try:
+            _jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
 
 from .context import Context, PartitioningMode
 from .presets import create_context_by_preset_name, create_default_context

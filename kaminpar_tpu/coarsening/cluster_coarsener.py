@@ -40,7 +40,9 @@ class ClusterCoarsener:
         self.input_communities = None
         if ctx.coarsening.algorithm == ClusteringAlgorithm.LP:
             self.clusterer: Optional[LPClustering] = LPClustering(
-                ctx.coarsening.lp, ctx.coarsening.overlay_levels
+                ctx.coarsening.lp,
+                ctx.coarsening.overlay_levels,
+                weighted_graph=self._input_weighted(),
             )
         elif ctx.coarsening.algorithm == ClusteringAlgorithm.HEM:
             from .hem_clusterer import HEMClustering
@@ -48,6 +50,20 @@ class ClusterCoarsener:
             self.clusterer = HEMClustering(ctx.coarsening.lp)
         else:
             self.clusterer = None
+
+    def _input_weighted(self) -> bool:
+        """Non-uniform edge weights on the *input* graph (decided once so
+        the weighted clustering mode cannot flip mid-hierarchy as
+        contraction accumulates weights).  The facade pins the decision in
+        ctx for nested pipelines, whose subgraphs carry accumulated
+        weights even when the user's graph is unweighted."""
+        pinned = self.ctx.coarsening.lp.weighted_mode
+        if pinned is not None:
+            return bool(pinned)
+        g = self.input_graph
+        if g is None or g.m == 0:
+            return False
+        return not g.has_uniform_edge_weights()
 
     def set_communities(self, communities) -> None:
         import jax.numpy as jnp
@@ -139,6 +155,7 @@ class ClusterCoarsener:
                             cluster_two_hop_nodes=False,
                         ),
                         self.ctx.coarsening.overlay_levels,
+                        weighted_graph=self.clusterer.weighted_graph,
                     )
                 else:
                     # HEM's eligibility already requires w > 0, so the masked

@@ -184,6 +184,13 @@ class CSRGraph:
     def is_unweighted(self) -> bool:
         return bool(jnp.all(self.node_w == 1)) and bool(jnp.all(self.edge_w == 1))
 
+    def has_uniform_edge_weights(self) -> bool:
+        """All edge weights equal (device-side reduce; only scalars reach
+        the host).  Gates the weighted clustering mode (lp_clusterer.py)."""
+        if self.m == 0:
+            return True
+        return bool(jnp.min(self.edge_w) == jnp.max(self.edge_w))
+
     def device_put(self, device=None) -> "CSRGraph":
         g = CSRGraph.__new__(CSRGraph)
         for attr in ("row_ptr", "col_idx", "node_w", "edge_w", "edge_u"):

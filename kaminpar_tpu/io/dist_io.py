@@ -291,6 +291,23 @@ def streaming_rmat_sharded(
     n_loc = -(n // -num_shards)
     chunks = -(num_edges // -chunk_edges)
 
+    def assemble(u, v, lo, hi):
+        # dedup within the shard's rows (weights collapse to 1, matching
+        # KaGen's simple-graph output rather than weight-summing)
+        key = (u - lo) * n + v
+        order = np.argsort(key, kind="stable")
+        key, u, v = key[order], u[order], v[order]
+        first = np.ones(len(key), dtype=bool)
+        first[1:] = key[1:] != key[:-1]
+        u, v = u[first], v[first]
+        deg = np.bincount(u - lo, minlength=hi - lo)
+        row_ptr = np.zeros(hi - lo + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        return HostChunk(
+            lo, hi, row_ptr, v, np.ones(hi - lo, dtype=np.int64),
+            np.ones(len(v), dtype=np.int64),
+        )
+
     def chunk_pairs(ci: int) -> np.ndarray:
         rng = np.random.default_rng((seed << 20) ^ ci)
         cnt = min(chunk_edges, num_edges - ci * chunk_edges)
@@ -302,7 +319,27 @@ def streaming_rmat_sharded(
             v = (v << 1) | ((r >= a) & (r < a + b) | (r >= a + b + c))
         return np.stack([u, v], axis=1)
 
-    tmpdir = tempfile.mkdtemp(prefix="kptpu_skagen_")
+    if num_shards == 1:
+        # Single shard: routing is a no-op — skip the disk round-trip (the
+        # spill exists to bound memory across *many* shards).
+        us, vs = [], []
+        for ci in range(chunks):
+            e = chunk_pairs(ci)
+            both_u = np.concatenate([e[:, 0], e[:, 1]])
+            both_v = np.concatenate([e[:, 1], e[:, 0]])
+            keep = both_u != both_v
+            us.append(both_u[keep])
+            vs.append(both_v[keep])
+        u = np.concatenate(us) if us else np.zeros(0, dtype=np.int64)
+        v = np.concatenate(vs) if vs else np.zeros(0, dtype=np.int64)
+        yield 0, (0, n), assemble(u, v, 0, n)
+        return
+
+    # Spill dir: honor KPTPU_SPILL_DIR (on many hosts /tmp is tmpfs, which
+    # would put the routed stream back in RAM and void the memory bound).
+    tmpdir = tempfile.mkdtemp(
+        prefix="kptpu_skagen_", dir=os.environ.get("KPTPU_SPILL_DIR")
+    )
     try:
         paths = [os.path.join(tmpdir, f"shard{j}.bin") for j in range(num_shards)]
         for ci in range(chunks):
@@ -330,22 +367,7 @@ def streaming_rmat_sharded(
                 arr = np.fromfile(paths[s], dtype=np.int64).reshape(-1, 2)
             else:
                 arr = np.zeros((0, 2), dtype=np.int64)
-            u, v = arr[:, 0], arr[:, 1]
-            # dedup within the shard's rows (weights collapse to 1, matching
-            # KaGen's simple-graph output rather than weight-summing)
-            key = (u - lo) * n + v
-            order = np.argsort(key, kind="stable")
-            key, u, v = key[order], u[order], v[order]
-            first = np.ones(len(key), dtype=bool)
-            first[1:] = key[1:] != key[:-1]
-            u, v = u[first], v[first]
-            deg = np.bincount(u - lo, minlength=hi - lo)
-            row_ptr = np.zeros(hi - lo + 1, dtype=np.int64)
-            np.cumsum(deg, out=row_ptr[1:])
-            yield s, (lo, hi), HostChunk(
-                lo, hi, row_ptr, v, np.ones(hi - lo, dtype=np.int64),
-                np.ones(len(v), dtype=np.int64),
-            )
+            yield s, (lo, hi), assemble(arr[:, 0], arr[:, 1], lo, hi)
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 

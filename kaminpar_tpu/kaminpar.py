@@ -41,6 +41,7 @@ class KaMinPar:
         self.graph: Optional[CSRGraph] = None
         self.compressed_graph: Optional[object] = None
         self._last: Optional[PartitionedGraph] = None
+        self._auto_weighted_pin = False
 
     # -- graph input -------------------------------------------------------
 
@@ -53,6 +54,11 @@ class KaMinPar:
         future step (graph/compressed.py)."""
         from .graph.compressed import CompressedGraph, compress
 
+        # A weighted-mode pin auto-detected from a previous graph must not
+        # stick to a new one (explicit user pins are kept).
+        if self._auto_weighted_pin:
+            self.ctx.coarsening.lp.weighted_mode = None
+            self._auto_weighted_pin = False
         if isinstance(graph, CompressedGraph):
             self.compressed_graph: Optional[object] = graph
             graph = None
@@ -126,6 +132,23 @@ class KaMinPar:
         RandomState.reseed(ctx.seed)
         Timer.reset_global()
         start = time.perf_counter()
+
+        # Pin the weighted-clustering mode to the *user's* graph so nested
+        # extension pipelines (whose subgraphs carry accumulated weights
+        # even for unweighted inputs) inherit the decision; see
+        # LabelPropagationContext.weighted_mode.  Auto-pins are restored
+        # to None at the end of this call so a later set_graph() with a
+        # different graph re-detects instead of inheriting a stale mode.
+        if ctx.coarsening.lp.weighted_mode is None and src.m > 0:
+            if graph is not None:
+                ctx.coarsening.lp.weighted_mode = not graph.has_uniform_edge_weights()
+            else:
+                # CompressedGraph stores edge_w=None when all weights are 1.
+                cew = cg.edge_w
+                ctx.coarsening.lp.weighted_mode = bool(
+                    cew is not None and np.min(cew) != np.max(cew)
+                )
+            self._auto_weighted_pin = True
 
         total_node_weight = int(src.total_node_weight)
         max_node_weight = (

@@ -86,24 +86,29 @@ def capacity_auction_sorted(key, movers, target, node_w, base_weights, max_weigh
 
 def capacity_auction(
     key, movers, target, node_w, base_weights, max_weights, num_labels: int,
-    *, rounds: int = 6,
 ):
     """Strict capacity-respecting admission without a sort.
 
-    Round-based probabilistic commitment — the shm rendition of the dist LP
-    refiner's PROBABILISTIC strategy (dkaminpar.h:116-120) hardened to a
-    strict invariant: each round, still-pending movers toss a coin with
-    per-target probability ``slack / demand``; a target's tentative set is
-    committed only if it fits *entirely* within the remaining slack, else all
-    its tentatives bounce to the next round (so ``base + admitted <= max``
-    holds unconditionally).  The admission probability is damped 0.65^r across
-    retries, which converges geometrically under contention.  The uncontended
-    case (demand <= slack, i.e. p = 1) admits everything in round 0, so the
-    common path loses nothing vs. the sorted-prefix oracle.
+    Equivalent to the sorted-prefix oracle (:func:`capacity_auction_sorted`):
+    each mover draws an int32 priority, and a per-target priority
+    *threshold* is bisected bitwise (31 iterations of masked segment-sums)
+    to the largest value whose admitted weight still fits
+    ``max_weights[target] - base_weights[target]`` — i.e. the maximal
+    random-priority prefix, computed without ordering anything.
+    ``base + admitted <= max`` holds unconditionally.
 
-    Cost: ``rounds`` x (2 segment-sums + 2 gathers) — no 1D sort, which cuts
-    per-shape XLA compile time of every enclosing LP kernel by ~4-15 s
-    (measured on TPU v5e and XLA:CPU).
+    Cost: 31 x (1 masked segment-sum + gathers) — no 1D sort, which
+    cuts per-shape XLA compile time of every enclosing LP kernel by ~4-15 s
+    (measured on TPU v5e and XLA:CPU; 1D sort stages unroll in the TPU
+    lowering, row/segment ops don't).
+
+    The threshold is bisected over *integer* int32 priorities (31
+    iterations resolve every bit), so the admitted set is exactly the
+    sorted oracle's maximal prefix whenever priorities are distinct
+    (collisions: birthday-bounded, ~1e-5 of movers at n=262k; a float32
+    threshold was measurably worse — its 2^-24 resolution dropped the
+    marginal mover per target per round, a ~2.5% cut regression on
+    road512).
     """
     n = movers.shape[0]
     t_idx = jnp.where(movers, target, 0)
@@ -113,37 +118,26 @@ def capacity_auction(
     base_weights = jnp.asarray(base_weights, dtype=wdt)
     w_mover = jnp.where(movers, node_w, 0).astype(wdt)
     max_w_l = lookup(max_weights, jnp.arange(num_labels, dtype=jnp.int32)).astype(wdt)
+    slack = max_w_l - base_weights
+    prio = jax.random.randint(key, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
 
-    def body(r, carry):
-        accepted, extra = carry
-        pending = movers & ~accepted
-        w_p = jnp.where(pending, w_mover, 0)
-        demand = jax.ops.segment_sum(w_p, t_idx, num_segments=num_labels)
-        slack = max_w_l - base_weights - extra
-        ratio = jnp.clip(
-            slack.astype(jnp.float32) / jnp.maximum(demand, 1).astype(jnp.float32),
-            0.0,
-            1.0,
+    def body(i, thr):
+        # Set bit (30 - i) if the admitted weight still fits per target.
+        bit = jnp.int32(1) << (jnp.int32(30) - i)
+        cand = thr + bit
+        adm = movers & (prio < cand[t_idx])
+        demand = jax.ops.segment_sum(
+            jnp.where(adm, w_mover, 0), t_idx, num_segments=num_labels
         )
-        # Uncontended targets (demand fits) admit all pending movers outright;
-        # contended ones thin probabilistically, damped across retries.
-        p = jnp.where(demand <= slack, 1.0, ratio * 0.65 ** r.astype(jnp.float32))
-        coin = jax.random.uniform(jax.random.fold_in(key, r), (n,)) < p[t_idx]
-        tent = pending & coin
-        w_t = jnp.where(tent, w_mover, 0)
-        tw = jax.ops.segment_sum(w_t, t_idx, num_segments=num_labels)
-        fits = tw <= slack
-        admit = tent & fits[t_idx]
-        extra = extra + jnp.where(fits, tw, 0)
-        return accepted | admit, extra
+        fits = demand <= slack
+        return jnp.where(fits, cand, thr)
 
-    # Derive the initial carry elementwise from the inputs so its varying
-    # manual axes match the body output when this runs inside shard_map
-    # (fresh jnp.zeros would be replicated and fail the scan carry check).
-    accepted = movers & (movers != movers)
-    extra = base_weights - base_weights
-    accepted, _ = jax.lax.fori_loop(0, rounds, body, (accepted, extra))
-    return accepted
+    # Derive the carry elementwise from inputs so its varying manual axes
+    # match inside shard_map (fresh jnp.zeros would be replicated and fail
+    # the scan carry check).
+    thr = jnp.zeros_like(slack, dtype=jnp.int32) * slack.astype(jnp.int32)
+    thr = jax.lax.fori_loop(0, 31, body, thr)
+    return movers & (prio < thr[t_idx])
 
 
 @partial(jax.jit, static_argnames=("num_labels", "active_prob", "allow_tie_moves"))
@@ -444,34 +438,47 @@ def two_hop_match(
 ) -> LPState:
     labels, label_weights, num_moved = state
     n = labels.shape[0]
-    ids = jnp.arange(n, dtype=labels.dtype)
 
     # Singleton = node alone in its own cluster.
     cluster_sizes = jax.ops.segment_sum(
         jnp.ones(n, dtype=jnp.int32), labels, num_segments=num_labels
     )
-    singleton = (labels == ids) & (cluster_sizes[labels] == 1)
+    singleton = (labels == jnp.arange(n, dtype=labels.dtype)) & (
+        cluster_sizes[labels] == 1
+    )
     has = fconn > 0
-    eligible = singleton & has
 
-    # Group singletons that favor the same cluster: the lowest-id singleton
-    # per favored cluster anchors; the rest propose joining its (singleton)
-    # cluster, capped by the cluster-weight limit via the capacity auction.
-    # Sort-free replacement for the old pairwise lexsort merge — groups can
-    # exceed two members (closer to the reference's CAS chain at
-    # label_propagation.h:919-1120, which also fills clusters to the limit),
-    # and the auction keeps every group's total weight under the cap.
-    f_idx = jnp.where(eligible, favored, 0)
-    sentinel = jnp.asarray(n, dtype=labels.dtype)
-    rep = jax.ops.segment_min(
-        jnp.where(eligible, ids, sentinel), f_idx, num_segments=num_labels
+    # Pair up singletons that favor the same cluster: sort by favored id and
+    # merge odd positions into the preceding even position's cluster.
+    # NOTE: this is deliberately the *pairwise* lexsort merge, not a
+    # sort-free rep-grouping — grouping every singleton of a favored
+    # cluster into one rep merges 2-hop nodes that are mutually
+    # non-adjacent in bulk, which measured a ~10% cut regression on the
+    # weighted-grid road class (round-4 bisect).  The lexsort runs once
+    # per clustering level (not in the LP round loop), so its one-shape
+    # compile cost is amortized by the persistent cache.
+    fkey = jnp.where(singleton & has, favored, n)  # sentinel: not eligible
+    prio = jax.random.randint(kp, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    order2 = jnp.lexsort((prio, fkey))
+    f_s = fkey[order2]
+    first2 = run_starts(f_s)
+    rid2 = jnp.cumsum(first2.astype(jnp.int32)) - 1
+    starts = jax.ops.segment_max(
+        jnp.where(first2, jnp.arange(n, dtype=jnp.int32), 0), rid2, num_segments=n
     )
-    my_rep = rep[f_idx]
-    mover = eligible & (ids != my_rep)
-    target = jnp.where(mover, labels[jnp.minimum(my_rep, n - 1)], labels)
-    accept = capacity_auction(
-        kp, mover, target, node_w, label_weights, max_label_weights, num_labels
+    pos_in_run = jnp.arange(n, dtype=jnp.int32) - starts[rid2]
+    prev_node = jnp.concatenate([order2[:1], order2[:-1]])
+    partner_label = labels[prev_node]
+    valid = (f_s < n) & (pos_in_run % 2 == 1)
+    w_s = node_w[order2]
+    w_prev = jnp.concatenate([w_s[:1], w_s[:-1]])
+    # Clustering weight limits are a uniform scalar (every caller passes
+    # one; lp_clusterer.py builds it as a 0-d array on purpose) — a
+    # per-label table would need the favored cluster's own cap here.
+    fits = w_s + w_prev <= lookup(max_label_weights, 0)
+    merge = valid & fits
+    new_labels = labels.at[order2].set(
+        jnp.where(merge, partner_label, labels[order2])
     )
-    new_labels = jnp.where(mover & accept, target, labels)
     new_weights = jax.ops.segment_sum(node_w, new_labels, num_segments=num_labels)
     return LPState(new_labels, new_weights, num_moved)

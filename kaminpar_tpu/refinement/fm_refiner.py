@@ -200,84 +200,124 @@ def _kway_fm_pass(row_ptr, col_idx, edge_w, node_w, u_arr, part, bw, max_bw, k, 
     np.logical_or.at(border_mask, u_arr, part[u_arr] != part[col_idx])
     border = np.flatnonzero(border_mask)
 
+    # Localized searches (the reference's core FM design, fm_refiner.cc:
+    # 48-110): border seeds are consumed in random order; each search grows
+    # a *region* through a region-local PQ (only nodes adjacent to the
+    # region enter), so negative-gain excursions stay spatially coherent —
+    # the move that pays for an earlier negative one is in the same
+    # neighborhood, not wherever the global best gain happens to be.  A
+    # round-4 bisect measured the global-PQ variant recovering 3-8x less
+    # cut per level on weighted grids exactly because its excursions
+    # scatter.  Each region rolls back to its own best prefix
+    # (fm_refiner.cc commits the best prefix per localized search);
+    # rolled-back nodes are unlocked for other searches
+    # (unlock_locally_moved_nodes = true, presets.cc:353).
     locked = np.zeros(n, dtype=bool)
-    moves: list = []  # (u, from)
-    cur_delta = 0
-    best_delta = 0
-    best_prefix = 0
-    fruitless = 0
-    max_fruitless = max(ctx.num_fruitless_moves, int(ctx.alpha * np.sqrt(n)))
+    total_delta = 0
+    budget_hit = False
 
-    try:
-        heap = []
-        if len(border):
-            tos, gains = best_moves_rows(border)
+    order = rng.permutation(border) if len(border) else border
+    ptr = 0
+    while ptr < len(order) and not budget_hit:
+        seeds = []
+        while ptr < len(order) and len(seeds) < ctx.num_seed_nodes:
+            u = int(order[ptr])
+            ptr += 1
+            if not locked[u]:
+                seeds.append(u)
+        if not seeds:
+            continue
+
+        moves: list = []  # (u, from) — this region only
+        cur_delta = 0
+        best_delta = 0
+        best_prefix = 0
+        fruitless = 0
+        try:
+            seeds_arr = np.asarray(seeds)
+            tos, gains = best_moves_rows(seeds_arr)
             ok = tos >= 0
-            prios = rng.integers(1 << 30, size=int(ok.sum()))
             heap = [
                 (-int(g), int(p), int(u), int(t))
-                for u, t, g, p in zip(border[ok], tos[ok], gains[ok], prios)
+                for u, t, g, p in zip(
+                    seeds_arr[ok], tos[ok], gains[ok],
+                    rng.integers(1 << 30, size=int(ok.sum())),
+                )
             ]
-        heapq.heapify(heap)
+            heapq.heapify(heap)
 
-        while heap and fruitless < max_fruitless:
-            neg_gain, _, u, to = heapq.heappop(heap)
-            if locked[u]:
-                continue
-            # Lazy revalidation (reference: compute_best_gain on pop).
-            cur_to, cur_gain = best_move(u)
-            if cur_to < 0:
-                continue
-            if cur_to != to or -neg_gain != cur_gain:
-                heapq.heappush(heap, (-cur_gain, int(rng.integers(1 << 30)), u, cur_to))
-                continue
-
-            src = part[u]
-            w_u = int(node_w[u])
-            part[u] = cur_to
-            bw[src] -= w_u
-            bw[cur_to] += w_u
-            locked[u] = True
-            moves.append((u, src))
-            cur_delta -= cur_gain
-            if cur_delta < best_delta:
-                best_delta = cur_delta
-                best_prefix = len(moves)
-                fruitless = 0
-            else:
-                fruitless += 1
-
-            # u moved src -> cur_to: each neighbor's connection row shifts by
-            # the connecting edge weight; then re-push the unlocked neighbors
-            # with their (vectorized) new best moves.
-            s, e = row_ptr[u], row_ptr[u + 1]
-            nbrs = col_idx[s:e]
-            ws = edge_w[s:e]
-            conn.add(nbrs, src, -ws)
-            conn.add(nbrs, cur_to, ws)
-            live = nbrs[~locked[nbrs]]
-            if len(live):
-                live = np.unique(live)
-                tos, gains = best_moves_rows(live)
-                ok = tos >= 0
-                for v, t, g in zip(live[ok], tos[ok], gains[ok]):
+            while heap:
+                if fruitless >= max(
+                    ctx.num_fruitless_moves, int(ctx.alpha * np.sqrt(len(moves) + 1))
+                ):
+                    break
+                neg_gain, _, u, to = heapq.heappop(heap)
+                if locked[u]:
+                    continue
+                # Lazy revalidation (reference: compute_best_gain on pop).
+                cur_to, cur_gain = best_move(u)
+                if cur_to < 0:
+                    continue
+                if cur_to != to or -neg_gain != cur_gain:
                     heapq.heappush(
-                        heap, (-int(g), int(rng.integers(1 << 30)), int(v), int(t))
+                        heap, (-cur_gain, int(rng.integers(1 << 30)), u, cur_to)
                     )
-    except _ConnBudgetExceeded:
-        # Active set outgrew the sparse table's entry budget: end the pass
-        # here and keep its best prefix (the dense-matrix blow-up this
-        # bounds is exactly what the old max_nk gate prevented).
-        pass
+                    continue
 
-    # Roll back to the best prefix (connection rows are rebuilt next pass,
-    # so only part/bw must be restored).
-    for u, src in moves[best_prefix:][::-1]:
-        w_u = int(node_w[u])
-        bw[part[u]] -= w_u
-        bw[src] += w_u
-        part[u] = src
-    return best_delta
+                src = part[u]
+                w_u = int(node_w[u])
+                part[u] = cur_to
+                bw[src] -= w_u
+                bw[cur_to] += w_u
+                locked[u] = True
+                moves.append((u, src))
+                cur_delta -= cur_gain
+                if cur_delta < best_delta:
+                    best_delta = cur_delta
+                    best_prefix = len(moves)
+                    fruitless = 0
+                else:
+                    fruitless += 1
+
+                # u moved src -> cur_to: each neighbor's connection row
+                # shifts by the connecting edge weight; then push the
+                # unlocked neighbors into the *region* PQ.
+                s, e = row_ptr[u], row_ptr[u + 1]
+                nbrs = col_idx[s:e]
+                ws = edge_w[s:e]
+                conn.add(nbrs, src, -ws)
+                conn.add(nbrs, cur_to, ws)
+                live = nbrs[~locked[nbrs]]
+                if len(live):
+                    live = np.unique(live)
+                    tos, gains = best_moves_rows(live)
+                    ok = tos >= 0
+                    for v, t, g in zip(live[ok], tos[ok], gains[ok]):
+                        heapq.heappush(
+                            heap,
+                            (-int(g), int(rng.integers(1 << 30)), int(v), int(t)),
+                        )
+        except _ConnBudgetExceeded:
+            # Sparse table outgrew its entry budget: end the pass after
+            # rolling this region back to its best prefix like any other
+            # (the dense blow-up this bounds is what the old max_nk gate
+            # prevented).
+            budget_hit = True
+
+        # Region rollback to its best prefix; undone nodes unlock.
+        for u, src in moves[best_prefix:][::-1]:
+            w_u = int(node_w[u])
+            to = part[u]
+            bw[to] -= w_u
+            bw[src] += w_u
+            part[u] = src
+            locked[u] = False
+            s, e = row_ptr[u], row_ptr[u + 1]
+            conn.add(col_idx[s:e], to, -edge_w[s:e])
+            conn.add(col_idx[s:e], src, edge_w[s:e])
+        total_delta += best_delta
+
+    return total_delta
 
 
 class FMRefiner(Refiner):

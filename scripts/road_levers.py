@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Fast road-class lever loop (VERDICT r3 next #1).
+
+road512 (512^2 weighted grid, k=64) is the recorded target but costs ~5-10
+min/run on this box; road256 (256^2, k=64) reproduces the weighted-low-degree
+class at ~1/4 the cost for lever iteration.  Each run happens in a fresh
+subprocess (XLA:CPU JIT code memory is a finite contiguous region; hundreds
+of kernel compiles in one process exhaust it — see QUALITY_NOTES).
+
+Usage:
+  python scripts/road_levers.py --side 256 --seeds 1,2,3 --preset eco \
+      [--ref] [--lever name=value ...]
+
+Levers are forwarded to the child via KPTPU_LEVER_* env vars; the child
+applies them to the context after preset construction (see _apply_levers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_BIN = os.path.join(REPO, "build_ref", "apps", "KaMinPar")
+DATA = os.path.join(REPO, "bench_data")
+
+
+def fixture(side: int) -> str:
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from kaminpar_tpu.graph.csr import CSRGraph
+    from kaminpar_tpu.graph.generators import grid2d_graph
+    from kaminpar_tpu.io import write_metis
+
+    os.makedirs(DATA, exist_ok=True)
+    path = os.path.join(DATA, f"road{side}.metis")
+    if not os.path.exists(path):
+        g0 = grid2d_graph(side, side)
+        rp = np.asarray(g0.row_ptr)
+        col = np.asarray(g0.col_idx).astype(np.int64)
+        u = np.repeat(np.arange(g0.n, dtype=np.int64), np.diff(rp))
+        key = np.minimum(u, col) * g0.n + np.maximum(u, col)
+        ew = (key * 2654435761 % 9 + 1).astype(np.int32)
+        g = CSRGraph(g0.row_ptr, g0.col_idx, None, ew)
+        write_metis(g, path)
+        print(f"wrote {path} n={g.n} m={g.m}", file=sys.stderr)
+    return path
+
+
+_CHILD = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+from kaminpar_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(1)
+import numpy as np
+from kaminpar_tpu.graph import metrics
+from kaminpar_tpu.io import read_metis
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+
+ctx = create_context_by_preset_name({preset!r})
+ctx.seed = {seed}
+for kv in {levers!r}:
+    name, val = kv.split("=", 1)
+    obj = ctx
+    parts = name.split(".")
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    cur = getattr(obj, parts[-1])
+    typ = type(cur)
+    if typ is bool:
+        val = val in ("1", "true", "True")
+    else:
+        val = typ(val)
+    setattr(obj, parts[-1], val)
+g = read_metis({path!r})
+s = KaMinPar(ctx)
+s.set_graph(g)
+t0 = time.perf_counter()
+part = s.compute_partition({k}, epsilon=0.03)
+wall = time.perf_counter() - t0
+print("CHILD_RESULT", int(metrics.edge_cut(g, part)), f"{{wall:.1f}}")
+"""
+
+
+def run_ours(path: str, k: int, seed: int, preset: str, levers) -> tuple[int, float]:
+    code = _CHILD.format(repo=REPO, preset=preset, seed=seed, levers=list(levers), path=path, k=k)
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=7200,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("CHILD_RESULT"):
+            _, cut, wall = line.split()
+            return int(cut), float(wall)
+    raise RuntimeError(f"child failed: {out.stderr[-400:]}")
+
+
+def run_ref(path: str, k: int, seed: int, preset: str) -> tuple[int, float]:
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [REF_BIN, path, str(k), "-P", preset, f"--seed={seed}", "-t", "1"],
+        capture_output=True, text=True, timeout=7200,
+    )
+    wall = time.perf_counter() - t0
+    if out.returncode != 0:
+        raise RuntimeError(f"ref {preset} failed: {out.stderr[-300:]}")
+    return int(re.search(r"Edge cut:\s+(\d+)", out.stdout).group(1)), wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--side", type=int, default=256)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--seeds", default="1,2,3")
+    ap.add_argument("--preset", default="eco")
+    ap.add_argument("--ref", action="store_true")
+    ap.add_argument("--lever", action="append", default=[])
+    ap.add_argument("--tag", default="")
+    args = ap.parse_args()
+    path = fixture(args.side)
+    seeds = [int(s) for s in args.seeds.split(",")]
+
+    if args.ref:
+        cuts, walls = zip(*(run_ref(path, args.k, s, args.preset) for s in seeds))
+        print(f"ref  {args.preset:7s} mean {sum(cuts)/len(cuts):9.0f} cuts {list(cuts)} "
+              f"wall {sum(walls)/len(walls):6.1f}s", flush=True)
+
+    cuts, walls = zip(*(run_ours(path, args.k, s, args.preset, args.lever) for s in seeds))
+    tag = args.tag or ",".join(args.lever) or "base"
+    print(f"ours {args.preset:7s} [{tag}] mean {sum(cuts)/len(cuts):9.0f} cuts {list(cuts)} "
+          f"wall {sum(walls)/len(walls):6.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
