@@ -261,6 +261,13 @@ class FMContext:
     abortion_threshold: float = 0.999
     # Border seeds consumed per localized search region (presets.cc:350).
     num_seed_nodes: int = 10
+    # Deterministic per-pass work budget: a pass stops (after finishing its
+    # current region) once the summed degree of popped nodes exceeds
+    # factor * n.  Bounds the *sequential host* pass on dense graphs
+    # (rgg64k: deg ~50 makes full-border passes ~30x a road pass for no
+    # measured cut gain); the reference affords full passes because its
+    # searches run on all cores.  <= 0 disables.
+    pass_work_budget_factor: float = 32.0
     # TPU divergence: FM runs as a sequential host pass; JET is the at-scale
     # device refiner (see fm_refiner.py module docstring).  Below
     # ``dense_nk_threshold`` connection entries the pass uses a dense (n, k)
