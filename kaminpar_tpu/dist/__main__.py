@@ -1,0 +1,108 @@
+"""``python -m kaminpar_tpu.dist`` — the dKaMinPar binary equivalent.
+
+Reference: ``apps/dKaMinPar.cc:546`` (MPI init + parse + read + facade).
+The mesh replaces MPI_COMM_WORLD: by default all visible devices form a 1D
+``('nodes',)`` mesh; ``--shards N --virtual-cpu`` forces N virtual CPU
+devices — the CLI face of the KaTestrophe-style oversubscribed testing
+(SURVEY §4) and the way to exercise the distributed pipeline on a laptop.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..presets import get_preset_names
+
+    p = argparse.ArgumentParser(
+        prog="kaminpar_tpu.dist",
+        description="Distributed TPU-native balanced k-way graph partitioner "
+        "(dKaMinPar-equivalent; shards over a device mesh).",
+    )
+    p.add_argument("graph", help="input graph (METIS or ParHIP format)")
+    p.add_argument("k", type=int, help="number of blocks")
+    p.add_argument("-P", "--preset", default="default", choices=get_preset_names())
+    p.add_argument("-e", "--epsilon", type=float, default=0.03)
+    p.add_argument("-f", "--format", default=None, choices=["metis", "parhip"])
+    p.add_argument("-o", "--output", default=None, help="partition output file")
+    p.add_argument("-s", "--seed", type=int, default=None)
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("--shards", type=int, default=None,
+                   help="number of mesh shards (default: all visible devices)")
+    p.add_argument("--virtual-cpu", action="store_true",
+                   help="force --shards virtual CPU devices (test/dev mode; "
+                        "the oversubscribed-MPI analog)")
+    p.add_argument("--use-64bit", action="store_true",
+                   help="64-bit node/edge ids and weights")
+    args = p.parse_args(argv)
+
+    if args.virtual_cpu:
+        from ..utils.platform import force_cpu_devices
+
+        force_cpu_devices(args.shards or 8)
+
+    import jax
+    from jax.sharding import Mesh
+
+    from .. import io as kio
+    from ..presets import create_context_by_preset_name
+    from ..utils.logger import Logger, OutputLevel
+    from .partitioner import DKaMinPar
+
+    if args.quiet:
+        Logger.level = OutputLevel.QUIET
+    elif args.verbose:
+        Logger.level = OutputLevel.DEBUG
+
+    devs = jax.devices()
+    num = args.shards or len(devs)
+    if len(devs) < num:
+        print(f"error: need {num} devices, have {len(devs)} "
+              "(use --virtual-cpu for virtual shards)", file=sys.stderr)
+        return 2
+    mesh = Mesh(np.array(devs[:num]), ("nodes",))
+
+    ctx = create_context_by_preset_name(args.preset)
+    if args.seed is not None:
+        ctx.seed = args.seed
+    if args.use_64bit:
+        ctx.use_64bit_ids = True
+        jax.config.update("jax_enable_x64", True)
+
+    t0 = time.perf_counter()
+    graph = kio.read_graph(args.graph, args.format, use_64bit=ctx.use_64bit_ids)
+    Logger.log(
+        f"Input graph: n={graph.n} m={graph.m // 2} "
+        f"(read in {time.perf_counter() - t0:.2f}s); mesh={num} shards "
+        f"on {devs[0].platform}"
+    )
+
+    solver = DKaMinPar(mesh, ctx)
+    t0 = time.perf_counter()
+    part = solver.compute_partition(graph, args.k, epsilon=args.epsilon)
+    wall = time.perf_counter() - t0
+
+    from ..graph import metrics
+
+    cut = metrics.edge_cut(graph, part)
+    bw = np.bincount(part, weights=np.asarray(graph.node_w), minlength=args.k)
+    avg = graph.total_node_weight / args.k
+    Logger.log(
+        f"Partition: cut={cut} imbalance={bw.max() / avg - 1.0:.4f} "
+        f"k={args.k} wall={wall:.2f}s"
+    )
+    if args.output:
+        kio.write_partition(args.output, part)
+        Logger.log(f"Partition written to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

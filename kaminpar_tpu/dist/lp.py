@@ -354,6 +354,91 @@ def dist_cluster_iterate(mesh, key, labels, graph, max_w, *, num_rounds: int,
     return labels, total
 
 
+def _local_cluster_round_body(
+    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w
+):
+    """One shard-local clustering round: candidates restricted to locally
+    owned neighbors, so clusters never span shards and the round needs NO
+    communication (reference: local_lp_clusterer.cc — PE-local clusters by
+    construction; its whole point is conflict-free, exchange-free rounds).
+    """
+    from ..ops.lp import capacity_auction
+
+    idx = jax.lax.axis_index(AXIS)
+    kr, kp = jax.random.split(jax.random.fold_in(key, idx))
+    n_loc = labels_loc.shape[0]
+    base = idx.astype(labels_loc.dtype) * n_loc
+    real = node_w_loc > 0
+
+    # Cross-shard edges are masked to weight 0; flat_best_moves only adopts
+    # candidates with rating > 0, so ghost clusters are never eligible.
+    is_local_nb = col_loc < n_loc
+    w_m = jnp.where(is_local_nb, edge_w, 0)
+    cand = labels_loc[jnp.clip(col_loc, 0, n_loc - 1)]
+    dummy = jnp.zeros((1,), node_w_loc.dtype)
+    target, tconn, own_conn, has = flat_best_moves(
+        kr, edge_u, cand, w_m, labels_loc, node_w_loc,
+        dummy, jnp.asarray(0, node_w_loc.dtype), num_rows=n_loc,
+        external_only=False, respect_caps=False,
+    )
+    desired = jnp.where(has, target, labels_loc)
+    better = tconn > own_conn
+    mover = real & has & better & (desired != labels_loc)
+    # Adopted labels must be locally owned: a neighbor may itself carry a
+    # remote label when a global round ran earlier on this level.
+    mover = mover & (desired >= base) & (desired < base + n_loc)
+
+    loc_lbl = (labels_loc - base).astype(jnp.int32)
+    cw = jax.ops.segment_sum(node_w_loc, loc_lbl, num_segments=n_loc)
+    accept = capacity_auction(
+        kp, mover, (desired - base).astype(jnp.int32), node_w_loc, cw, max_w,
+        num_labels=n_loc,
+    )
+    final_labels = jnp.where(mover & accept, desired, labels_loc)
+    num_moved = jax.lax.psum(jnp.sum(mover & accept).astype(jnp.int32), AXIS)
+    return final_labels, num_moved
+
+
+@lru_cache(maxsize=None)
+def make_dist_local_cluster_round(mesh: Mesh):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_w):
+        return _local_cluster_round_body(
+            key, labels, node_w, edge_u, col_loc, edge_w, max_w
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_local_cluster_iterate(mesh, key, labels, graph, max_w, *,
+                               num_rounds: int):
+    """Shard-local clustering LP loop (reference: LOCAL_LP,
+    local_lp_clusterer.cc / ClusteringAlgorithm::LOCAL_LP, dkaminpar.h:73-78).
+
+    Clusters are restricted to one shard, so rounds are exchange-free and
+    conflict-free; coarse nodes land wholly on their owner, which also makes
+    the subsequent contraction's migration trivial.  Cheaper per round than
+    the global clusterer at the cost of never merging across shard
+    boundaries (the reference pairs it with global LP on alternating levels
+    for the same reason)."""
+    fn = make_dist_local_cluster_round(mesh)
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        labels, moved = fn(
+            jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
+            graph.col_loc, graph.edge_w, max_w,
+        )
+        if int(moved) == 0:
+            break
+        total = total + moved
+    return labels, total
+
+
 def shard_arrays(mesh: Mesh, graph, labels):
     """Place the graph + label arrays with their 1D shardings."""
     s = NamedSharding(mesh, P(AXIS))

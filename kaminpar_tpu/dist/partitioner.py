@@ -102,11 +102,22 @@ class DKaMinPar:
                 )
                 lab = jnp.arange(cur.N, dtype=cur.dtype)
                 lab, cur = shard_arrays(self.mesh, cur, lab)
-                lab, _ = dist_cluster_iterate(
-                    self.mesh, RandomState.next_key(), lab, cur,
-                    jnp.asarray(max_cw, cur.dtype),
-                    num_rounds=ctx.coarsening.lp.num_iterations,
-                )
+                from ..context import DistClusteringAlgorithm as DCA
+
+                algo = ctx.coarsening.dist_clustering
+                rounds = ctx.coarsening.lp.num_iterations
+                if algo in (DCA.LOCAL_LP, DCA.LOCAL_GLOBAL_LP):
+                    from .lp import dist_local_cluster_iterate
+
+                    lab, _ = dist_local_cluster_iterate(
+                        self.mesh, RandomState.next_key(), lab, cur,
+                        jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
+                    )
+                if algo in (DCA.GLOBAL_LP, DCA.LOCAL_GLOBAL_LP):
+                    lab, _ = dist_cluster_iterate(
+                        self.mesh, RandomState.next_key(), lab, cur,
+                        jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
+                    )
                 coarse, coarse_of, n_c = contract_dist_clustering(self.mesh, cur, lab)
                 if n_c < k:
                     # contraction overshot below k blocks — keep the finer

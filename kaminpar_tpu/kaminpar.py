@@ -104,6 +104,27 @@ class KaMinPar:
         min_epsilon: float = 0.0,
         min_block_weights: Optional[Sequence[int]] = None,
     ) -> np.ndarray:
+        try:
+            return self._compute_partition(
+                k, epsilon, max_block_weights, min_epsilon, min_block_weights
+            )
+        finally:
+            # An auto-detected weighted-mode pin is scoped to this call: a
+            # caller may mutate the current graph's edge weights in place and
+            # re-partition, and must get a fresh detection, not a stale mode.
+            # (Explicit user pins are kept.)
+            if self._auto_weighted_pin:
+                self.ctx.coarsening.lp.weighted_mode = None
+                self._auto_weighted_pin = False
+
+    def _compute_partition(
+        self,
+        k: int,
+        epsilon: float = 0.03,
+        max_block_weights: Optional[Sequence[int]] = None,
+        min_epsilon: float = 0.0,
+        min_block_weights: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
         """Partition into k blocks; returns the (n,) block-id array.
 
         Balance constraint: per-block weight <=
@@ -136,9 +157,8 @@ class KaMinPar:
         # Pin the weighted-clustering mode to the *user's* graph so nested
         # extension pipelines (whose subgraphs carry accumulated weights
         # even for unweighted inputs) inherit the decision; see
-        # LabelPropagationContext.weighted_mode.  Auto-pins are restored
-        # to None at the end of this call so a later set_graph() with a
-        # different graph re-detects instead of inheriting a stale mode.
+        # LabelPropagationContext.weighted_mode.  The wrapper above clears
+        # auto-pins when this call returns.
         if ctx.coarsening.lp.weighted_mode is None and src.m > 0:
             if graph is not None:
                 ctx.coarsening.lp.weighted_mode = not graph.has_uniform_edge_weights()

@@ -31,9 +31,25 @@ def extend_partition(
     graph: CSRGraph, part: np.ndarray, cur_k: int, new_k: int, ctx: Context
 ) -> np.ndarray:
     """Split every block of a cur_k-way partition so the result has new_k
-    blocks (reference: ``extend_partition``, partitioning/helper.cc:349 —
-    extract block subgraphs, bipartition each recursively).  Host-side; the
-    per-block subgraphs are small relative to the full graph."""
+    blocks (reference: ``extend_partition``, partitioning/helper.cc:349).
+
+    Large graphs take the device path (one restricted nested multilevel
+    batched over all blocks, partitioning/extension.py); smaller ones the
+    host per-block path below."""
+    ipc = ctx.initial_partitioning
+    if ipc.device_extension and new_k > cur_k and graph.n >= ipc.device_extension_n:
+        from .extension import extend_partition_device
+
+        return extend_partition_device(graph, part, cur_k, new_k, ctx)
+    return _extend_partition_host(graph, part, cur_k, new_k, ctx)
+
+
+def _extend_partition_host(
+    graph: CSRGraph, part: np.ndarray, cur_k: int, new_k: int, ctx: Context
+) -> np.ndarray:
+    """Host per-block extension: extract block subgraphs, bipartition each
+    recursively (subgraph_extractor.h:176 + helper.cc:143); the per-block
+    subgraphs are small relative to the full graph."""
     final_bw = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
     k = len(final_bw)
     off_new = split_offsets(k, new_k)

@@ -30,7 +30,15 @@ from .refiner import Refiner
 
 
 @partial(jax.jit, static_argnames=("k",))
-def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k: int):
+def _balance_round(
+    key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k: int,
+    group_of=None,
+):
+    """``group_of`` ((k,) label -> group id, optional): restricted mode for
+    device-side extension (partitioning/extension.py) — targets stay within
+    the mover's group.  Connection-based targets are already in-group when
+    the caller masks cross-group edge weights; the lightest-block fallback
+    here is what needs the explicit restriction."""
     n = labels.shape[0]
     kb, ks, kt = jax.random.split(key, 3)
     block_weights = jax.ops.segment_sum(node_w, labels, num_segments=k)
@@ -43,8 +51,18 @@ def _balance_round(key, labels, buckets, heavy, gather_idx, node_w, max_bw, *, k
     overloaded = block_weights > max_bw
     mover = overloaded[labels] & (node_w > 0)  # weight-0 nodes are shape padding
 
-    # Fallback for movers with no adjacent feasible target: lightest block.
-    light = jnp.argmin(block_weights)
+    # Fallback for movers with no adjacent feasible target: lightest block
+    # (within the mover's group in restricted mode).
+    if group_of is None:
+        light = jnp.argmin(block_weights)
+    else:
+        gw_min = jax.ops.segment_min(block_weights, group_of, num_segments=k)
+        blk = jnp.arange(k, dtype=jnp.int32)
+        light_of_group = jax.ops.segment_min(
+            jnp.where(block_weights == gw_min[group_of], blk, k),
+            group_of, num_segments=k,
+        )
+        light = jnp.clip(light_of_group[group_of[labels]], 0, k - 1)
     fallback_ok = block_weights[light] + node_w <= max_bw[light]
     use_fb = mover & ~has & fallback_ok & (labels != light)
     target = jnp.where(use_fb, light, target)
@@ -105,7 +123,11 @@ def _admit_by_budget(mask, block_of, rel, node_w, budget, k: int, *, inclusive: 
     rel_lo = jnp.where(mask, relf, pos)
     rel_hi = jnp.where(mask, relf, neg)
     lo = jax.ops.segment_min(rel_lo, b_idx, num_segments=k)  # admit-all end
-    hi = jax.ops.segment_max(rel_hi, b_idx, num_segments=k) + 1.0  # admit-none
+    # Admit-none sentinel: the bump must survive float32 absorption at any
+    # magnitude (max+1.0 is a no-op once |max| >= 2^24), so scale it like the
+    # callers' tie-breaking jitter.
+    hi = jax.ops.segment_max(rel_hi, b_idx, num_segments=k)
+    hi = hi + jnp.maximum(jnp.abs(hi), 1.0) * 1e-3
 
     def body(_, carry):
         lo, hi = carry

@@ -120,7 +120,9 @@ class _SparseConn:
             raise _ConnBudgetExceeded
         if need > self.rows.shape[0]:
             cap = min(max(need, 2 * self.rows.shape[0]), self.max_rows)
-            self.rows = np.resize(self.rows, (cap, self.k))
+            grown = np.zeros((cap, self.k), dtype=self.rows.dtype)
+            grown[: self.used] = self.rows[: self.used]
+            self.rows = grown
         degs = (self.row_ptr[new + 1] - self.row_ptr[new]).astype(np.int64)
         total = int(degs.sum())
         starts = self.row_ptr[new]

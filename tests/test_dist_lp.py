@@ -92,6 +92,34 @@ def test_dist_cluster_iterate_coarsens():
     assert w.max() <= 64
 
 
+def test_dist_local_cluster_stays_shard_local():
+    """LOCAL_LP clusterer (reference: local_lp_clusterer.cc): clusters never
+    span shards, rounds are exchange-free, caps hold."""
+    from kaminpar_tpu.dist.lp import dist_local_cluster_iterate
+
+    mesh = _mesh()
+    g = generators.rmat_graph(10, 8, seed=3)
+    dg = distribute_graph(g, mesh.size)
+    N = dg.N
+    labels = jnp.arange(N, dtype=jnp.int32)
+    labels, dgs = shard_arrays(mesh, dg, labels)
+    out, total = dist_local_cluster_iterate(
+        mesh, jax.random.key(4), labels, dgs, jnp.int32(32), num_rounds=4
+    )
+    out = np.asarray(out)
+    assert int(total) > 0
+    # every node's cluster id is owned by the node's own shard
+    shard_of_node = np.arange(N) // dg.n_loc
+    shard_of_label = out // dg.n_loc
+    assert np.all(shard_of_label == shard_of_node)
+    # caps hold and real coarsening happened
+    w = np.bincount(out[: g.n], minlength=N)
+    assert w.max() <= 32
+    assert len(np.unique(out[: g.n])) < 0.8 * g.n
+    # pads never move
+    assert np.all(out[g.n :] == np.arange(g.n, N))
+
+
 def test_cluster_auction_keeps_feasibility():
     """The owner-side capacity auction must never admit weight beyond the
     cluster cap, across seeds (the reference's growt weight-rollback

@@ -127,6 +127,46 @@ def test_dkaminpar_endtoend(gen, k):
     assert metrics.edge_cut(g, part) < rand_cut
 
 
+def test_dkaminpar_cli_entry(tmp_path):
+    """dKaMinPar binary analog (apps/dKaMinPar.cc:546): parse, mesh, read,
+    partition, write."""
+    from kaminpar_tpu.dist.__main__ import main as dist_main
+    from kaminpar_tpu.graph import generators
+    from kaminpar_tpu.io import write_metis
+
+    g = generators.grid2d_graph(16, 16)
+    gpath = tmp_path / "g.metis"
+    opath = tmp_path / "part.txt"
+    write_metis(g, str(gpath))
+    rc = dist_main([str(gpath), "4", "--shards", "4", "-s", "1", "-q",
+                    "-o", str(opath)])
+    assert rc == 0
+    part = np.loadtxt(opath, dtype=np.int64)
+    assert part.shape == (g.n,)
+    assert set(np.unique(part)) <= set(range(4))
+
+
+def test_dist_local_global_clustering_pipeline():
+    """LOCAL_GLOBAL_LP coarsening (reference pairs LOCAL_LP with global
+    rounds) through the full dist pipeline."""
+    from kaminpar_tpu.context import DistClusteringAlgorithm
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    mesh = _mesh()
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.dist_clustering = DistClusteringAlgorithm.LOCAL_GLOBAL_LP
+    g = generators.rmat_graph(10, 8, seed=9)
+    k = 8
+    solver = DKaMinPar(mesh, ctx)
+    part = solver.compute_partition(g, k=k)
+    assert part.shape == (g.n,)
+    w = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
+    limit = (1.03 * g.total_node_weight + k - 1) // k + g.max_node_weight
+    assert w.max() <= limit
+    rng = np.random.default_rng(0)
+    assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
+
+
 def test_dist_deep_extends_partition():
     """VERDICT r1 #7 done-criterion: dist deep must produce k > k0 through
     extension during uncoarsening (reference: dist deep_multilevel.cc
