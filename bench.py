@@ -234,10 +234,12 @@ def probe_telemetry() -> dict | None:
         "attempts": len(attempts),
         "outcomes": outcomes,
         "events": events,
-        # raw per-attempt records (ts + outcome) for windowed queries
+        # per-attempt records (ts + outcome) for windowed queries; capped to
+        # the most recent 50 so a multi-round append-only log cannot bloat
+        # the one-line artifact (the 6h failure window needs far fewer)
         "attempt_records": [
             {"ts": a.get("ts"), "iso": a.get("iso"), "outcome": a.get("outcome")}
-            for a in attempts
+            for a in attempts[-50:]
         ],
     }
     if attempts:

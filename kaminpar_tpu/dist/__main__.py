@@ -48,18 +48,30 @@ def main(argv=None) -> int:
 
         force_cpu_devices(args.shards or 8)
 
-    import jax
-    from jax.sharding import Mesh
-
-    from .. import io as kio
-    from ..presets import create_context_by_preset_name
     from ..utils.logger import Logger, OutputLevel
-    from .partitioner import DKaMinPar
 
+    prev_level = Logger.level
     if args.quiet:
         Logger.level = OutputLevel.QUIET
     elif args.verbose:
         Logger.level = OutputLevel.DEBUG
+    try:
+        return _run(args)
+    finally:
+        # Logger.level is process-global; restore it so in-process callers
+        # (tests invoke main() as a function) are unaffected.
+        Logger.level = prev_level
+
+
+def _run(args) -> int:
+    import jax
+    from jax.sharding import Mesh
+
+    from .. import io as kio
+    from ..graph import metrics
+    from ..presets import create_context_by_preset_name
+    from ..utils.logger import Logger
+    from .partitioner import DKaMinPar
 
     devs = jax.devices()
     num = args.shards or len(devs)
@@ -88,8 +100,6 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     part = solver.compute_partition(graph, args.k, epsilon=args.epsilon)
     wall = time.perf_counter() - t0
-
-    from ..graph import metrics
 
     cut = metrics.edge_cut(graph, part)
     bw = np.bincount(part, weights=np.asarray(graph.node_w), minlength=args.k)

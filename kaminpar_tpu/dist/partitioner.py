@@ -208,9 +208,36 @@ class DKaMinPar:
                     results = list(pool.map(one_rep, range(reps)))
             finally:
                 timer.enable()
-            for cand, cand_cut in results:
-                if best_cut is None or cand_cut < best_cut:
-                    part_host, best_cut = cand, cand_cut
+            # Mesh splitting (deep_multilevel.cc:80-96 / replicator.cc):
+            # with R candidates and P divisible by R, refine + select on R
+            # disjoint sub-meshes in one device program — the replica
+            # groups work concurrently, no host-side selection loop.
+            if reps >= 2 and P % reps == 0:
+                from .replicate import refine_replicated
+
+                parts_R = np.stack([c for c, _ in results])
+                perfect = (int(coarse_host.total_node_weight) + k0 - 1) // k0
+                cap0 = np.full(
+                    k0,
+                    max(int((1.0 + epsilon) * perfect),
+                        perfect + int(coarse_host.max_node_weight)),
+                    dtype=np.int64,
+                )
+                part_host, rep_cuts = refine_replicated(
+                    self.mesh, RandomState.next_key(), parts_R, coarse_host,
+                    jnp.asarray(cap0, dtype=dtype), k=k0,
+                    num_rounds=ctx.refinement.lp.num_iterations,
+                )
+                best_cut = int(rep_cuts.min())
+                Logger.log(
+                    f"  dist IP mesh-split: {reps} replica groups x "
+                    f"{P // reps} shards, cuts {rep_cuts.tolist()}",
+                    OutputLevel.DEBUG,
+                )
+            else:
+                for cand, cand_cut in results:
+                    if best_cut is None or cand_cut < best_cut:
+                        part_host, best_cut = cand, cand_cut
             Logger.log(
                 f"  dist IP: coarsest n={coarse_host.n} k0={k0} reps={reps} "
                 f"cut={best_cut}",

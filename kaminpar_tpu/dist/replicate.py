@@ -1,0 +1,118 @@
+"""Mesh splitting: concurrent best-of-R replica refinement on sub-meshes.
+
+Reference: dist deep multilevel PE-splitting
+(``kaminpar-dist/partitioning/deep_multilevel.cc:80-96`` +
+``graphutils/replicator.cc``): when the coarse graph is small relative to the
+PE count, the communicator is split into R groups, each group replicates the
+graph and partitions independently, and the best result wins
+(``distribute_best_partition``).
+
+TPU redesign: the 1D ``('nodes',)`` mesh of P devices reshapes to a
+``('rep', 'nodes')`` mesh of (R, P//R); graph arrays are *replicated* across
+``rep`` and sharded across ``nodes``; candidate partitions carry a leading
+replica dimension.  The existing per-shard LP refinement round body runs
+unchanged inside the 2D shard_map — its collectives name only the ``nodes``
+axis, so every psum/all_to_all stays inside one replica group by
+construction.  Per-replica cuts psum over ``nodes`` and selection is an
+argmin over the replica dimension: R independent refinement+selection runs
+in ONE device program, no host threads.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .exchange import AXIS, ghost_exchange
+from .lp import _neighbor_labels, _refine_round_body
+
+REP_AXIS = "rep"
+
+
+def split_mesh(mesh: Mesh, R: int) -> Mesh:
+    """Reshape a 1D ('nodes',) mesh into ('rep', 'nodes') = (R, P//R)."""
+    devs = mesh.devices.reshape(-1)
+    S = len(devs) // R
+    if S < 1:
+        raise ValueError(f"cannot split {len(devs)} devices into {R} groups")
+    return Mesh(devs[: R * S].reshape(R, S), (REP_AXIS, AXIS))
+
+
+@lru_cache(maxsize=None)
+def make_replicated_refine(mesh2: Mesh, *, num_labels: int, num_rounds: int):
+    """R replica groups refine their own candidate labels concurrently and
+    report per-replica cuts; one jitted program."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh2,
+        in_specs=(P(), P(REP_AXIS, AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                  P(), P(AXIS), P(AXIS)),
+        out_specs=(P(REP_AXIS, AXIS), P(REP_AXIS)),
+    )
+    def fn(key, labels2, node_w, edge_u, col_loc, edge_w, max_w, send_idx,
+           recv_map):
+        rep = jax.lax.axis_index(REP_AXIS)
+        lab = labels2[0]  # (n_loc,) — this group's replica
+        krep = jax.random.fold_in(key, rep)
+
+        def body(i, lab):
+            lab, _ = _refine_round_body(
+                jax.random.fold_in(krep, i), lab, node_w, edge_u, col_loc,
+                edge_w, max_w, send_idx, recv_map, jnp.int32(0), jnp.int32(i),
+                num_labels=num_labels, external_only=False,
+            )
+            return lab
+
+        lab = jax.lax.fori_loop(0, num_rounds, body, lab)
+        # Per-replica cut (double-counted; halved by the caller), psum'd only
+        # over this group's 'nodes' axis.
+        ghosts = ghost_exchange(
+            lab, send_idx, recv_map, fill=jnp.asarray(0, lab.dtype)
+        )
+        nbr = _neighbor_labels(lab, ghosts, col_loc, 0)
+        own = lab[edge_u]
+        cut2 = jax.lax.psum(
+            jnp.sum(jnp.where(own != nbr, edge_w, 0)), AXIS
+        )
+        return lab[None, :], cut2[None]
+
+    return jax.jit(fn)
+
+
+def refine_replicated(mesh: Mesh, key, parts_R: np.ndarray, coarse_host,
+                      max_w, *, k: int, num_rounds: int):
+    """Refine R candidate partitions of ``coarse_host`` concurrently on R
+    disjoint sub-meshes of ``mesh``; return (best_part, per_replica_cuts).
+
+    ``parts_R`` is (R, n) host labels.  The graph is re-sharded over the
+    P//R 'nodes' shards of each group (replicated across groups)."""
+    from .graph import distribute_graph
+
+    R = parts_R.shape[0]
+    mesh2 = split_mesh(mesh, R)
+    S = mesh2.devices.shape[1]
+    dg = distribute_graph(coarse_host, S)
+    labels2 = np.zeros((R, dg.N), dtype=np.int32)
+    labels2[:, : coarse_host.n] = parts_R[:, : coarse_host.n]
+
+    rep_sh = NamedSharding(mesh2, P(REP_AXIS, AXIS))
+    node_sh = NamedSharding(mesh2, P(AXIS))
+    labels_dev = jax.device_put(jnp.asarray(labels2), rep_sh)
+    args = [
+        jax.device_put(a, node_sh)
+        for a in (dg.node_w, dg.edge_u, dg.col_loc, dg.edge_w, dg.send_idx,
+                  dg.recv_map)
+    ]
+    fn = make_replicated_refine(mesh2, num_labels=k, num_rounds=num_rounds)
+    out_labels, cuts2 = fn(
+        key, labels_dev, args[0], args[1], args[2], args[3],
+        jnp.asarray(max_w), args[4], args[5],
+    )
+    cuts = np.asarray(cuts2) // 2
+    best = int(np.argmin(cuts))
+    return np.asarray(out_labels[best])[: coarse_host.n], cuts

@@ -178,7 +178,8 @@ def run_attempt(attempt: int) -> dict | None:
 
 
 def daemon_loop() -> None:
-    deadline = time.time() + DEADLINE_H * 3600
+    t_daemon_start = time.time()
+    deadline = t_daemon_start + DEADLINE_H * 3600
     _log({"event": "prober_start", "pid": os.getpid(),
           "init_timeout_s": INIT_TIMEOUT_S, "attempt_timeout_s": ATTEMPT_TIMEOUT_S,
           "retry_sleep_s": RETRY_SLEEP_S, "deadline_h": DEADLINE_H})
@@ -194,18 +195,22 @@ def daemon_loop() -> None:
             rec = None
         if rec is not None:
             try:
-                rec["git_head"] = subprocess.run(
-                    ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
-                    capture_output=True, text=True, timeout=10,
-                ).stdout.strip()
+                sys.path.insert(0, REPO)
+                from bench import _git_head
+
+                rec["git_head"] = _git_head()
             except Exception:  # noqa: BLE001
                 pass
             with open(RESULT_PATH, "w") as fh:
                 json.dump(rec, fh, indent=1)
             _log({"event": "prober_success", "attempt": attempt})
             return
-        if os.path.exists(RESULT_PATH):
-            return  # someone else (a manual run) captured a result
+        if (
+            os.path.exists(RESULT_PATH)
+            and os.path.getmtime(RESULT_PATH) >= t_daemon_start
+        ):
+            return  # someone else captured a result THIS round; a stale
+            # artifact from an earlier round must not stop the daemon
         time.sleep(min(RETRY_SLEEP_S, max(0.0, deadline - time.time())))
     _log({"event": "prober_deadline", "attempts": attempt})
 

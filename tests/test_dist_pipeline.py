@@ -167,6 +167,107 @@ def test_dist_local_global_clustering_pipeline():
     assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
 
 
+def test_mesh_split_replica_refinement():
+    """Mesh splitting (deep_multilevel.cc:80-96): R=2 replica groups refine
+    two candidates concurrently on disjoint sub-meshes; the returned winner
+    matches the reported per-replica cuts."""
+    from kaminpar_tpu.dist.replicate import refine_replicated, split_mesh
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(20, 20)
+    k = 4
+    mesh2 = split_mesh(mesh, 2)
+    assert mesh2.devices.shape == (2, 4)
+    assert mesh2.axis_names == ("rep", "nodes")
+
+    rng = np.random.default_rng(3)
+    # replica 0: random garbage; replica 1: a sane-ish stripes partition —
+    # selection must prefer the better refined cut
+    parts_R = np.stack([
+        rng.integers(0, k, g.n).astype(np.int32),
+        (np.arange(g.n) * k // g.n).astype(np.int32),
+    ])
+    cap = jnp.full(k, int(1.2 * g.total_node_weight / k) + 4, dtype=jnp.int32)
+    best, cuts = refine_replicated(
+        mesh, jax.random.key(0), parts_R, g, cap, k=k, num_rounds=3
+    )
+    assert best.shape == (g.n,)
+    assert len(cuts) == 2
+    # the winner's actual cut equals the reported minimum
+    assert metrics.edge_cut(g, best) == int(cuts.min())
+    # refinement improved on both starts
+    assert int(cuts.min()) < metrics.edge_cut(g, parts_R[0])
+
+
+def test_dist_nontoy_rmat14_full_partition():
+    """Non-toy dist e2e (VERDICT r4 next-steps #6): RMAT scale-14 on the
+    8-device mesh — (a) cut within a factor of the shm pipeline's, (b) the
+    exchange overflow-doubling path fires at least once under a forced small
+    cap, (c) validate_partition passes.  Match:
+    tests/endtoend/dist_endtoend_test.cc (the oversubscribed-MPI e2e)."""
+    import kaminpar_tpu.dist.lp as dlp
+    from kaminpar_tpu.dist.debug import validate_partition
+    from kaminpar_tpu.dist.lp import dist_cluster_iterate, shard_arrays
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    mesh = _mesh()
+    g = generators.rmat_graph(14, 14, seed=5)
+    k = 16
+
+    # (b) overflow-doubling witness: iterate with a deliberately tiny owner
+    # buffer; record the cap_q escalation through the factory.
+    caps_used = []
+    orig_factory = dlp.make_dist_cluster_round
+
+    def recording_factory(mesh_, *, cap_q):
+        caps_used.append(cap_q)
+        return orig_factory(mesh_, cap_q=cap_q)
+
+    dg = distribute_graph(g, mesh.size)
+    labels = jnp.arange(dg.N, dtype=jnp.int32)
+    labels, dgs = shard_arrays(mesh, dg, labels)
+    dlp.make_dist_cluster_round = recording_factory
+    try:
+        out, _ = dist_cluster_iterate(
+            mesh, jax.random.key(3), labels, dgs, jnp.int32(64),
+            num_rounds=2, cap_q=64,
+        )
+    finally:
+        dlp.make_dist_cluster_round = orig_factory
+    assert len(caps_used) >= 2 and max(caps_used) > 64, (
+        f"overflow-doubling never fired: caps {caps_used}"
+    )
+    # the escalated rounds still respect the cluster cap
+    w = np.bincount(np.asarray(out)[: g.n], minlength=dg.N)
+    assert w.max() <= 64
+
+    # (a)+(c) full pipeline at scale 14
+    ctx = create_context_by_preset_name("fast")
+    ctx.seed = 1
+    solver = DKaMinPar(mesh, ctx)
+    part = solver.compute_partition(g, k=k, epsilon=0.03)
+    dist_cut = metrics.edge_cut(g, part)
+
+    shm_ctx = create_context_by_preset_name("fast")
+    shm_ctx.seed = 1
+    s = KaMinPar(shm_ctx)
+    s.set_graph(g)
+    shm_cut = metrics.edge_cut(g, s.compute_partition(k, epsilon=0.03))
+    assert dist_cut <= 1.5 * shm_cut, (dist_cut, shm_cut)
+
+    # (c) validate on a re-sharded finest graph + partition
+    dgf = distribute_graph(g, mesh.size)
+    pfull = np.zeros(dgf.N, dtype=np.int32)
+    pfull[: g.n] = part
+    plab, dgs2 = shard_arrays(mesh, dgf, jnp.asarray(pfull))
+    W = g.total_node_weight
+    cap = np.full(k, int(np.ceil(W / k) * 1.03) + int(g.max_node_weight),
+                  dtype=np.int64)
+    ok, problems = validate_partition(mesh, plab, dgs2, k, cap)
+    assert ok, problems
+
+
 def test_dist_deep_extends_partition():
     """VERDICT r1 #7 done-criterion: dist deep must produce k > k0 through
     extension during uncoarsening (reference: dist deep_multilevel.cc
