@@ -239,6 +239,10 @@ class InitialPartitioningContext:
     device_extension: bool = False
     device_extension_n: int = 1 << 15
     device_extension_cpb: int = 320
+    # Independent device-extension attempts, best full-graph cut wins
+    # (extension variance was the rgg64k plateau driver; same rationale as
+    # nested_extension_reps on the host path).
+    device_extension_reps: int = 1
 
 
 @dataclass

@@ -85,18 +85,21 @@ def make_replicated_refine(mesh2: Mesh, *, num_labels: int, num_rounds: int):
 
 
 def refine_replicated(mesh: Mesh, key, parts_R: np.ndarray, coarse_host,
-                      max_w, *, k: int, num_rounds: int):
+                      max_w, *, k: int, num_rounds: int, dtype=np.int32):
     """Refine R candidate partitions of ``coarse_host`` concurrently on R
     disjoint sub-meshes of ``mesh``; return (best_part, per_replica_cuts).
 
     ``parts_R`` is (R, n) host labels.  The graph is re-sharded over the
-    P//R 'nodes' shards of each group (replicated across groups)."""
+    P//R 'nodes' shards of each group (replicated across groups); ``dtype``
+    must match the pipeline's id/weight width (int64 under use_64bit_ids —
+    silent int32 wrapping of accumulated coarse weights would corrupt the
+    balance decisions and cuts)."""
     from .graph import distribute_graph
 
     R = parts_R.shape[0]
     mesh2 = split_mesh(mesh, R)
     S = mesh2.devices.shape[1]
-    dg = distribute_graph(coarse_host, S)
+    dg = distribute_graph(coarse_host, S, dtype=dtype)
     labels2 = np.zeros((R, dg.N), dtype=np.int32)
     labels2[:, : coarse_host.n] = parts_R[:, : coarse_host.n]
 

@@ -289,23 +289,30 @@ def from_edge_list(
 def validate(graph: CSRGraph) -> None:
     """Check structural invariants (reference: graphutils/graph_validator.cc):
     sorted row_ptr, in-range col_idx, no self loops, symmetric adjacency with
-    matching weights.  Host-side; intended for tests and debug flag."""
+    matching weights.  Host-side; intended for tests, the debug flag, and the
+    heavy assertion tier.  Raises ``ValueError`` (not bare asserts, which
+    ``python -O`` would strip out from under the KASSERT ladder)."""
+
+    def _check(cond, msg):
+        if not cond:
+            raise ValueError(f"invalid graph: {msg}")
+
     row_ptr = np.asarray(graph.row_ptr)
     col = np.asarray(graph.col_idx)
     ew = np.asarray(graph.edge_w)
     n, m = graph.n, graph.m
-    assert row_ptr[0] == 0 and row_ptr[-1] == m, "row_ptr range"
-    assert np.all(np.diff(row_ptr) >= 0), "row_ptr monotone"
+    _check(row_ptr[0] == 0 and row_ptr[-1] == m, "row_ptr range")
+    _check(np.all(np.diff(row_ptr) >= 0), "row_ptr monotone")
     if m == 0:
         return
-    assert col.min() >= 0 and col.max() < n, "col_idx in range"
+    _check(col.min() >= 0 and col.max() < n, "col_idx in range")
     u = np.asarray(graph.edge_u)
-    assert not np.any(u == col), "self loops present"
+    _check(not np.any(u == col), "self loops present")
     fwd = {}
     for a, b, w in zip(u.tolist(), col.tolist(), ew.tolist()):
         fwd[(a, b)] = fwd.get((a, b), 0) + w
     for (a, b), w in fwd.items():
-        assert fwd.get((b, a)) == w, f"asymmetric edge {(a, b)}"
+        _check(fwd.get((b, a)) == w, f"asymmetric edge {(a, b)}")
 
 
 def rearrange_by_degree_buckets(graph: CSRGraph) -> Tuple[CSRGraph, np.ndarray]:

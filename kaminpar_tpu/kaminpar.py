@@ -59,6 +59,18 @@ class KaMinPar:
         if self._auto_weighted_pin:
             self.ctx.coarsening.lp.weighted_mode = None
             self._auto_weighted_pin = False
+        if graph is not None and not isinstance(graph, CompressedGraph):
+            # Heavy-tier input validation inside normal runs (reference:
+            # KASSERT heavy validate_graph on every graph adoption,
+            # kaminpar.cc:174).  O(m) host-side; active only when the
+            # assertion ladder is raised to "heavy".  validate raises
+            # ValueError itself (robust under python -O) — the ladder only
+            # gates whether it runs.
+            from .graph.csr import validate
+            from .utils.assertions import HEAVY, assertion_level
+
+            if assertion_level() >= HEAVY:
+                validate(graph)
         if isinstance(graph, CompressedGraph):
             self.compressed_graph: Optional[object] = graph
             graph = None
@@ -286,6 +298,19 @@ class KaMinPar:
         self._last = p_graph
 
         part = np.asarray(p_graph.partition)
+        # Assertion ladder on the output (reference: partition KASSERTs,
+        # kaminpar.cc / partitioned_graph.h): range check at light,
+        # feasibility re-check at heavy.
+        from .utils.assertions import HEAVY, LIGHT, kassert
+
+        kassert(lambda: part.size == 0 or (part.min() >= 0 and part.max() < k),
+                "partition labels out of range", LIGHT)
+        kassert(
+            lambda: bool(
+                metrics.is_feasible(graph, part, k, ctx.partition.max_block_weights)
+            ),
+            "partition violates block weight caps", HEAVY,
+        )
         elapsed = time.perf_counter() - start
         cut = p_graph.edge_cut()
         imb = p_graph.imbalance()

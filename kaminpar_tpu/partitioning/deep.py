@@ -38,9 +38,19 @@ def extend_partition(
     host per-block path below."""
     ipc = ctx.initial_partitioning
     if ipc.device_extension and new_k > cur_k and graph.n >= ipc.device_extension_n:
+        from ..graph import metrics as _metrics
         from .extension import extend_partition_device
 
-        return extend_partition_device(graph, part, cur_k, new_k, ctx)
+        reps = max(ipc.device_extension_reps, 1)
+        best, best_cut = None, None
+        for _ in range(reps):
+            cand = extend_partition_device(graph, part, cur_k, new_k, ctx)
+            if reps == 1:
+                return cand
+            cut = int(_metrics.edge_cut(graph, cand))
+            if best_cut is None or cut < best_cut:
+                best, best_cut = cand, cut
+        return best
     return _extend_partition_host(graph, part, cur_k, new_k, ctx)
 
 

@@ -1,16 +1,21 @@
+from .assertions import assertion_level, kassert, kassert_heavy, set_assertion_level
 from .logger import Logger, OutputLevel, log_result_line
 from .platform import force_cpu_devices
 from .rng import RandomState, next_key, reseed
 from .timer import Timer, scoped_timer
 
 __all__ = [
+    "assertion_level",
     "force_cpu_devices",
+    "kassert",
+    "kassert_heavy",
     "Logger",
     "OutputLevel",
     "log_result_line",
     "RandomState",
     "next_key",
     "reseed",
+    "set_assertion_level",
     "Timer",
     "scoped_timer",
 ]

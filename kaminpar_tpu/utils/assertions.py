@@ -1,0 +1,52 @@
+"""Leveled runtime assertions — the KASSERT ladder.
+
+Reference: ``kaminpar-common/assert.h:40-50`` — assertion levels
+``always < light < normal < heavy``; the build selects a level and every
+``KASSERT(expr, msg, level)`` at or below it is compiled in.  Heavy-level
+assertions validate whole graphs/partitions inside normal runs
+(kaminpar.cc:174, dkaminpar.cc:506-509) and double as test oracles
+(SURVEY §4).
+
+The TPU build selects the level at runtime: ``KAMINPAR_TPU_ASSERT``
+environment variable or :func:`set_assertion_level` ("none", "always",
+"light", "normal", "heavy"; default "always").  Checks above the active
+level cost one integer compare.
+"""
+
+from __future__ import annotations
+
+import os
+
+ALWAYS, LIGHT, NORMAL, HEAVY = 1, 2, 3, 4
+_NAMES = {"none": 0, "always": ALWAYS, "light": LIGHT, "normal": NORMAL,
+          "heavy": HEAVY}
+
+_level = _NAMES.get(os.environ.get("KAMINPAR_TPU_ASSERT", "always"), ALWAYS)
+
+
+def set_assertion_level(name: str) -> None:
+    if name not in _NAMES:
+        raise ValueError(f"unknown assertion level {name!r}; one of {list(_NAMES)}")
+    global _level
+    _level = _NAMES[name]
+
+
+def assertion_level() -> int:
+    return _level
+
+
+def kassert(cond, msg: str = "", level: int = ALWAYS) -> None:
+    """``KASSERT(cond, msg, level)``: raise AssertionError when the check is
+    active (level <= the configured ladder level) and ``cond`` is falsy.
+    ``cond`` may be a callable for checks whose evaluation is itself
+    expensive (the heavy tier's whole point)."""
+    if level > _level:
+        return
+    if callable(cond):
+        cond = cond()
+    if not cond:
+        raise AssertionError(msg or "KASSERT failed")
+
+
+def kassert_heavy(cond, msg: str = "") -> None:
+    kassert(cond, msg, HEAVY)

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Characterize the host FM pass at the max_n gate (VERDICT r4 next #7).
+
+Measures ONE localized-FM refinement pass (including the device->host
+transfer of graph + partition) at n = 1M and n = 8M, k = 64, on this box —
+the data behind the ``fm.max_n`` default (context.py FMContext).  The only
+prior anchor was ~1 s at n = 65k (DIVERGENCES #3); naive scaling predicted
+minutes at the 2^23 gate, unmeasured until now.
+
+Writes a QUALITY_NOTES-ready JSON line per scale to
+``bench_data/fm_characterization.jsonl``.
+
+Usage: python scripts/fm_characterize.py [--scales 20,23] [--k 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, REPO)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scales", default="20,23")
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--edge-factor", type=int, default=8)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from kaminpar_tpu.context import Context
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.graph.partitioned import PartitionedGraph
+    from kaminpar_tpu.refinement.fm_refiner import FMRefiner
+    from kaminpar_tpu.utils import RandomState
+
+    out_path = os.path.join(REPO, "bench_data", "fm_characterization.jsonl")
+    k = args.k
+    for scale in (int(s) for s in args.scales.split(",")):
+        RandomState.reseed(1)
+        t0 = time.perf_counter()
+        g = rmat_graph(scale, edge_factor=args.edge_factor, seed=1)
+        gen_s = time.perf_counter() - t0
+        # A plausible mid-refinement partition: balanced stripes + one LP
+        # sweep would be fairer but slower; stripes already produce a busy
+        # border, which is what the pass cost scales with.
+        part = (np.arange(g.n) * k // max(g.n, 1)).astype(np.int32)
+        W = int(g.total_node_weight)
+        max_bw = np.full(k, int(np.ceil(W / k) * 1.05) + 64, dtype=np.int64)
+        pg = PartitionedGraph.create(g, k, part, max_bw)
+        cut0 = pg.edge_cut()
+
+        ctx = Context()
+        ctx.refinement.fm.max_n = 1 << 24  # open the gate for measurement
+        refiner = FMRefiner(ctx.refinement.fm)
+        t0 = time.perf_counter()
+        out = refiner.refine(pg)
+        pass_s = time.perf_counter() - t0
+        cut1 = out.edge_cut()
+        rec = {
+            "scale": scale, "n": g.n, "m": g.m, "k": k,
+            "gen_s": round(gen_s, 1),
+            "fm_pass_s": round(pass_s, 1),
+            "cut_before": int(cut0), "cut_after": int(cut1),
+            "improvement_pct": round(100 * (1 - cut1 / max(cut0, 1)), 2),
+        }
+        print(json.dumps(rec), flush=True)
+        with open(out_path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Strong-tier proof sweep (VERDICT r4 next-steps #5).
+
+Runs the strong AND eco presets over the 5 eval configs x 3 seeds, printing
+one JSON line per run (progressively, so a killed sweep still yields data)
+and a final summary.  Done-criterion: strong >= eco on all configs, <=1.05x
+the reference on >= 4 of 5.
+
+Usage: python scripts/strong_sweep.py [--configs ...] [--seeds 1,2,3]
+       [--presets strong] [--devext]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, REPO)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+CONFIGS = {
+    # name: (path, k, ref mean cut over seeds {1,2,3}, ref source preset)
+    "rmat14": ("bench_data/rmat14.metis", 16, 116535.0, "default"),
+    "grid256": ("bench_data/grid256.metis", 64, 4218.0, "default"),
+    "rgg64k": ("bench_data/rgg64k.metis", 64, 120000.0, "default"),
+    "road256": ("bench_data/road256.metis", 64, 16698.0, "default"),
+    "road512": ("bench_data/road512.metis", 64, 24061.0, "default"),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="rmat14,grid256,rgg64k,road256,road512")
+    ap.add_argument("--seeds", default="1,2,3")
+    ap.add_argument("--presets", default="strong")
+    ap.add_argument("--devext", action="store_true")
+    ap.add_argument("--out", default="bench_data/strong_sweep.jsonl")
+    args = ap.parse_args()
+
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.io import read_metis
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    seeds = [int(s) for s in args.seeds.split(",")]
+    out_path = os.path.join(REPO, args.out)
+    means: dict = {}
+    for name in args.configs.split(","):
+        path, k, ref, _ = CONFIGS[name]
+        g = read_metis(os.path.join(REPO, path))
+        for preset in args.presets.split(","):
+            cuts, walls = [], []
+            for seed in seeds:
+                ctx = create_context_by_preset_name(preset)
+                ctx.seed = seed
+                if args.devext:
+                    ctx.initial_partitioning.device_extension = True
+                s = KaMinPar(ctx)
+                s.set_graph(g)
+                t0 = time.perf_counter()
+                part = s.compute_partition(k, epsilon=0.03)
+                wall = time.perf_counter() - t0
+                cut = int(metrics.edge_cut(g, part))
+                feas = bool(s.last_partition.is_feasible())
+                rec = {"config": name, "preset": preset, "seed": seed,
+                       "cut": cut, "feasible": feas, "wall_s": round(wall, 1),
+                       "devext": bool(args.devext)}
+                print(json.dumps(rec), flush=True)
+                with open(out_path, "a") as fh:
+                    fh.write(json.dumps(rec) + "\n")
+                cuts.append(cut)
+                walls.append(wall)
+            mean = sum(cuts) / len(cuts)
+            means[(name, preset)] = mean
+            print(json.dumps({
+                "config": name, "preset": preset, "mean_cut": round(mean, 1),
+                "ratio_vs_ref": round(mean / ref, 3),
+                "spread": [min(cuts), max(cuts)],
+                "mean_wall_s": round(sum(walls) / len(walls), 1),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

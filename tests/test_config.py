@@ -58,3 +58,49 @@ def test_cli_dump_config():
     assert out.returncode == 0, out.stderr
     assert 'preset_name = "eco"' in out.stdout
     assert "[refinement]" in out.stdout
+
+
+def test_assertion_ladder():
+    """KASSERT ladder (reference: kaminpar-common/assert.h:40-50): checks
+    above the active level are skipped; callables defer evaluation."""
+    import pytest
+
+    from kaminpar_tpu.utils.assertions import (
+        HEAVY,
+        LIGHT,
+        assertion_level,
+        kassert,
+        set_assertion_level,
+    )
+
+    prev = assertion_level()
+    try:
+        set_assertion_level("always")
+        kassert(False, "inactive at always", LIGHT)  # no raise
+        exploded = []
+        kassert(lambda: exploded.append(1) or True, "", HEAVY)
+        assert not exploded  # heavy callable never evaluated
+        set_assertion_level("heavy")
+        with pytest.raises(AssertionError, match="boom"):
+            kassert(lambda: False, "boom", HEAVY)
+    finally:
+        set_assertion_level(
+            {1: "always", 2: "light", 3: "normal", 4: "heavy", 0: "none"}[prev]
+        )
+
+
+def test_dist_preset_ladder():
+    """dist preset ladder (reference: dist presets.cc:18-286)."""
+    from kaminpar_tpu.context import (
+        DistClusteringAlgorithm,
+        RefinementAlgorithm,
+    )
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    fast = create_context_by_preset_name("dist-fast")
+    assert fast.coarsening.dist_clustering == DistClusteringAlgorithm.LOCAL_GLOBAL_LP
+    strong = create_context_by_preset_name("dist-strong")
+    assert RefinementAlgorithm.CLP in strong.refinement.algorithms
+    assert RefinementAlgorithm.JET in strong.refinement.algorithms
+    largek = create_context_by_preset_name("dist-largek")
+    assert largek.initial_partitioning.device_extension

@@ -167,6 +167,32 @@ def test_dist_local_global_clustering_pipeline():
     assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
 
 
+def test_dist_sharded_extension_pipeline():
+    """Sharded extension path (dist/extension.py): the full dist pipeline
+    with device_extension engaged at test sizes — no per-level full
+    replication — still yields a valid balanced partition."""
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    mesh = _mesh()
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.contraction_limit = 64
+    ctx.initial_partitioning.device_extension = True
+    ctx.initial_partitioning.device_extension_n = 512
+    ctx.initial_partitioning.device_extension_cpb = 16
+    k = 16
+    g = generators.rmat_graph(12, 8, seed=3)
+    solver = DKaMinPar(mesh, ctx)
+    part = solver.compute_partition(g, k=k, epsilon=0.05)
+    assert part.shape == (g.n,)
+    assert len(np.unique(part)) == k
+    W = g.total_node_weight
+    per = int(np.ceil(W / k) * 1.05) + int(np.asarray(g.node_w).max())
+    bw = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
+    assert (bw <= per).all(), bw
+    rng = np.random.default_rng(0)
+    assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
+
+
 def test_mesh_split_replica_refinement():
     """Mesh splitting (deep_multilevel.cc:80-96): R=2 replica groups refine
     two candidates concurrently on disjoint sub-meshes; the returned winner
