@@ -62,6 +62,16 @@ def _tokenize(data: bytes):
 
 
 def read_metis(path: str, *, use_64bit: bool = False) -> CSRGraph:
+    # Native (C++ mmap) tokenizer first — the reference's IO layer is C++
+    # (metis_parser.cc) and so is ours; transparent NumPy fallback when the
+    # toolchain is unavailable (io/native.py).
+    from .native import parse_metis_native
+
+    parsed = parse_metis_native(path)
+    if parsed is not None:
+        row_ptr, col_idx, node_w, edge_w = parsed
+        return from_numpy_csr(row_ptr, col_idx, node_w, edge_w,
+                              use_64bit=use_64bit)
     with open(path, "rb") as f:
         data = f.read()
     values, line = _tokenize(data)

@@ -88,3 +88,69 @@ def test_reference_rgg2d_parhip_matches_metis():
     for variant in ("rgg2d-32bit.parhip", "rgg2d-64bit.parhip"):
         gp = read_graph(f"{REF_MISC}/{variant}", "parhip")
         _assert_graph_equal(gm, gp)
+
+
+def test_native_parser_matches_numpy(tmp_path, rng):
+    """The C++ mmap tokenizer (io/_native/metis_native.cpp, the reference's
+    metis_parser.cc analog) must agree exactly with the NumPy parser on
+    weighted/unweighted graphs with comments and degree-0 nodes."""
+    import kaminpar_tpu.io.native as nv
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.io import write_metis
+    from kaminpar_tpu.io.metis import read_metis
+
+    if not nv.native_available():
+        pytest.skip("native toolchain unavailable")
+
+    g = rmat_graph(8, 6, seed=4)
+    # make it weighted both ways
+    import numpy as _np
+
+    nw = rng.integers(1, 9, g.n)
+    # symmetric edge weights: hash of the unordered pair
+    u = _np.asarray(g.edge_u)
+    v = _np.asarray(g.col_idx)
+    ew = 1 + (_np.minimum(u, v) * 31 + _np.maximum(u, v)) % 7
+    from kaminpar_tpu.graph.csr import CSRGraph
+
+    gw = CSRGraph(_np.asarray(g.row_ptr), v, nw, ew)
+    path = tmp_path / "w.metis"
+    write_metis(gw, str(path))
+    # sprinkle a comment line after the header
+    lines = path.read_text().split("\n")
+    lines.insert(1, "% a comment")
+    path.write_text("\n".join(lines))
+
+    g_nat = read_metis(str(path))
+    # Force the NumPy path: _load() short-circuits on a loaded _lib, so the
+    # flag alone is not enough — the lib handle must be cleared too.
+    saved_lib = nv._lib
+    nv._lib, nv._lib_failed = None, True
+    try:
+        g_np = read_metis(str(path))
+    finally:
+        nv._lib, nv._lib_failed = saved_lib, False
+    for attr in ("row_ptr", "col_idx", "node_w", "edge_w"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(g_nat, attr)), np.asarray(getattr(g_np, attr)),
+            err_msg=attr,
+        )
+
+
+def test_native_parser_rejects_malformed(tmp_path):
+    import kaminpar_tpu.io.native as nv
+
+    if not nv.native_available():
+        pytest.skip("native toolchain unavailable")
+    bad = tmp_path / "bad.metis"
+    bad.write_text("2 1\n2 x\n1\n")
+    with pytest.raises(ValueError, match="non-negative"):
+        nv.parse_metis_native(str(bad))
+    wrong_count = tmp_path / "count.metis"
+    wrong_count.write_text("2 2\n2\n1\n")
+    with pytest.raises(ValueError, match="edge count"):
+        nv.parse_metis_native(str(wrong_count))
+    dangling = tmp_path / "dangling.metis"
+    dangling.write_text("2 1 1\n2\n1 1\n")  # node 0 lists a neighbor, no weight
+    with pytest.raises(ValueError, match="dangling"):
+        nv.parse_metis_native(str(dangling))
