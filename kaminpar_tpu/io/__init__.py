@@ -14,6 +14,7 @@ import os
 import numpy as np
 
 from ..graph.csr import CSRGraph
+from .compressed_io import read_compressed, write_compressed
 from .metis import read_metis, write_metis
 from .parhip import read_parhip, write_parhip
 
@@ -21,6 +22,9 @@ from .parhip import read_parhip, write_parhip
 class GraphFileFormat(enum.Enum):
     METIS = "metis"
     PARHIP = "parhip"
+    # compressed binary (reference: graph_compression_binary.cc; ours is the
+    # fixed-width gap-packed scheme — io/compressed_io.py)
+    COMPRESSED = "compressed"
 
 
 def _detect(path: str) -> GraphFileFormat:
@@ -29,6 +33,8 @@ def _detect(path: str) -> GraphFileFormat:
         return GraphFileFormat.PARHIP
     if ext in (".metis", ".graph"):
         return GraphFileFormat.METIS
+    if ext in (".npz", ".compressed"):
+        return GraphFileFormat.COMPRESSED
     # sniff: a ParHIP header's first 8 bytes are a small bitmask (< 64)
     with open(path, "rb") as f:
         head = f.read(8)
@@ -51,6 +57,8 @@ def read_graph(
         file_format = GraphFileFormat(file_format.lower())
     if file_format == GraphFileFormat.METIS:
         return read_metis(path, use_64bit=use_64bit)
+    if file_format == GraphFileFormat.COMPRESSED:
+        return read_compressed(path)
     return read_parhip(path, use_64bit=use_64bit)
 
 
@@ -63,15 +71,18 @@ def write_graph(
 ) -> None:
     if file_format is None:
         ext = os.path.splitext(path)[1].lower()
-        file_format = (
-            GraphFileFormat.PARHIP
-            if ext in (".parhip", ".bgf", ".bin")
-            else GraphFileFormat.METIS
-        )
+        if ext in (".parhip", ".bgf", ".bin"):
+            file_format = GraphFileFormat.PARHIP
+        elif ext in (".npz", ".compressed"):
+            file_format = GraphFileFormat.COMPRESSED
+        else:
+            file_format = GraphFileFormat.METIS
     elif isinstance(file_format, str):
         file_format = GraphFileFormat(file_format.lower())
     if file_format == GraphFileFormat.METIS:
         write_metis(graph, path)
+    elif file_format == GraphFileFormat.COMPRESSED:
+        write_compressed(graph, path)
     else:
         write_parhip(graph, path, use_64bit=use_64bit)
 

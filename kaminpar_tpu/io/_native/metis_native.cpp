@@ -41,7 +41,13 @@ static const char *kErrLines = "more adjacency lines than nodes";
 static const char *kErrCount = "edge count does not match header";
 static const char *kErrRange = "neighbor id out of range";
 static const char *kErrWeight = "adjacency line ends with a dangling edge weight slot";
+static const char *kErrBig = "integer token too large";
 static const char *kErrOom = "out of memory";
+
+// Matches the NumPy parser's exact-float64 bound: tokens >= 2^53 are
+// rejected there, so the native path must reject them too (parse results
+// must not depend on which parser ran).
+static const int64_t kMaxToken = (int64_t{1} << 53) - 1;
 
 namespace {
 
@@ -66,10 +72,16 @@ struct Toker {
   }
 
   // Parse one unsigned integer; returns false at whitespace-only tail or on
-  // a non-digit byte (err set).
-  bool next(int64_t *out, const char **err) {
-    skip_ws_and_comments(nullptr);
-    if (p >= end) return false;
+  // a non-digit byte (err set).  ``same_line`` restricts the scan to the
+  // current line (header tokens must not leak in from adjacency lines).
+  bool next(int64_t *out, const char **err, bool same_line = false) {
+    if (same_line) {
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+      if (p >= end || *p == '\n' || *p == '%') return false;
+    } else {
+      skip_ws_and_comments(nullptr);
+      if (p >= end) return false;
+    }
     if (*p < '0' || *p > '9') {
       *err = kErrToken;
       return false;
@@ -77,6 +89,10 @@ struct Toker {
     int64_t v = 0;
     while (p < end && *p >= '0' && *p <= '9') {
       v = v * 10 + (*p - '0');
+      if (v > kMaxToken) {
+        *err = kErrBig;
+        return false;
+      }
       ++p;
     }
     *out = v;
@@ -136,29 +152,40 @@ int kp_parse_metis(const char *path, KpMetisGraph *g) {
   Toker tk{data, data + size};
   const char *err = nullptr;
   int64_t n = 0, m_und = 0, fmt = 0;
-  if (!tk.next(&n, &err) || !tk.next(&m_und, &err)) {
+  // header = the first line carrying tokens; comment/blank lines skip.
+  // Header tokens are LINE-BOUNDED (same_line=true): a one-token header
+  // must error, not silently pull n's partner from an adjacency line.
+  for (;;) {
+    tk.skip_comment_lines();
+    if (tk.p < tk.end &&
+        (*tk.p == '\n' || *tk.p == ' ' || *tk.p == '\t' || *tk.p == '\r')) {
+      ++tk.p;
+      continue;
+    }
+    break;
+  }
+  if (!tk.next(&n, &err, true) || !tk.next(&m_und, &err, true)) {
     munmap(const_cast<char *>(data), size);
     g->error = err ? err : kErrHeader;
     return 1;
   }
-  {
-    // optional fmt token: only if it appears on the header line
-    const char *save = tk.p;
-    bool nl = false;
-    tk.skip_ws_and_comments(&nl);
-    if (!nl && tk.p < tk.end) {
-      if (!tk.next(&fmt, &err)) {
-        munmap(const_cast<char *>(data), size);
-        g->error = err ? err : kErrHeader;
-        return 1;
-      }
-    } else {
-      tk.p = save;
-    }
+  if (!tk.next(&fmt, &err, true) && err) {  // optional fmt, same line only
+    munmap(const_cast<char *>(data), size);
+    g->error = err;
+    return 1;
   }
   bool has_ew = fmt % 10 == 1;
   bool has_nw = (fmt / 10) % 10 == 1;
   int64_t m = 2 * m_und;
+  // File-size sanity bounds header claims BEFORE any allocation: every
+  // directed edge needs at least one byte of file, every node one line.
+  // This also makes the (n+1)/m size_t multiplications below wrap-proof.
+  if (n < 0 || m_und < 0 || n > static_cast<int64_t>(size) + 1 ||
+      m > static_cast<int64_t>(size)) {
+    munmap(const_cast<char *>(data), size);
+    g->error = kErrHeader;
+    return 1;
+  }
 
   g->n = n;
   g->m = m;
@@ -220,6 +247,12 @@ int kp_parse_metis(const char *path, KpMetisGraph *g) {
       int64_t v = 0;
       while (tk.p < tk.end && *tk.p >= '0' && *tk.p <= '9') {
         v = v * 10 + (*tk.p - '0');
+        if (v > kMaxToken) {
+          kp_free_graph(g);
+          munmap(const_cast<char *>(data), size);
+          g->error = kErrBig;
+          return 1;
+        }
         ++tk.p;
       }
       if (first_tok && has_nw) {

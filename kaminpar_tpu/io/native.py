@@ -86,6 +86,10 @@ def parse_metis_native(path: str):
     lib = _load()
     if lib is None:
         return None
+    if not os.path.isfile(path):
+        # keep the exception type toolchain-independent: the NumPy path
+        # raises FileNotFoundError from open()
+        open(path, "rb").close()
     g = _KpMetisGraph()
     rc = lib.kp_parse_metis(os.fsencode(path), ctypes.byref(g))
     try:

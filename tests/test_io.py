@@ -154,3 +154,64 @@ def test_native_parser_rejects_malformed(tmp_path):
     dangling.write_text("2 1 1\n2\n1 1\n")  # node 0 lists a neighbor, no weight
     with pytest.raises(ValueError, match="dangling"):
         nv.parse_metis_native(str(dangling))
+
+
+def test_compressed_binary_roundtrip(tmp_path):
+    """Compressed-graph binary (reference: graph_compression_binary.cc):
+    write compressed, read back, decompress to the identical CSR; the
+    facade partitions the loaded compressed graph directly."""
+    from kaminpar_tpu.graph.compressed import compress
+    from kaminpar_tpu.io import read_graph, write_graph
+
+    g = generators.rgg2d_graph(512, radius=0.06, seed=3)
+    path = str(tmp_path / "g.npz")
+    write_graph(g, path, "compressed")
+    cg = read_graph(path)  # auto-detected by extension
+    from kaminpar_tpu.graph.compressed import CompressedGraph
+
+    assert isinstance(cg, CompressedGraph)
+    assert cg.compression_ratio() == compress(g).compression_ratio()
+    h = cg.decompress()
+    _assert_graph_equal(g, h)
+
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    s = KaMinPar("default")
+    s.set_graph(cg)
+    part = s.compute_partition(4)
+    assert metrics.is_feasible(g, part, 4, s.ctx.partition.max_block_weights)
+
+
+def test_native_parser_hardening(tmp_path):
+    """Parser-divergence and hardening cases found in review: one-token
+    headers, huge header claims, oversized tokens, missing files must all
+    behave identically to the NumPy path."""
+    import kaminpar_tpu.io.native as nv
+
+    if not nv.native_available():
+        pytest.skip("native toolchain unavailable")
+    one_token_header = tmp_path / "h1.metis"
+    one_token_header.write_text("2\n1\n2\n1\n")
+    with pytest.raises(ValueError):
+        nv.parse_metis_native(str(one_token_header))
+    huge = tmp_path / "huge.metis"
+    huge.write_text("1 2305843009213693952\n\n")
+    with pytest.raises(ValueError):
+        nv.parse_metis_native(str(huge))
+    big_tok = tmp_path / "big.metis"
+    big_tok.write_text("2 1 1\n2 18446744073709551617\n1 1\n")
+    with pytest.raises(ValueError, match="too large"):
+        nv.parse_metis_native(str(big_tok))
+    with pytest.raises(FileNotFoundError):
+        nv.parse_metis_native(str(tmp_path / "missing.metis"))
+
+
+def test_write_graph_npz_default_roundtrips(tmp_path):
+    from kaminpar_tpu.io import read_graph, write_graph
+
+    g = generators.grid2d_graph(6, 6)
+    path = str(tmp_path / "g.npz")
+    write_graph(g, path)  # extension decides: compressed container
+    h = read_graph(path).decompress()
+    _assert_graph_equal(g, h)
