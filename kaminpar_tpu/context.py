@@ -36,8 +36,7 @@ class ClusteringAlgorithm(enum.Enum):
 
 class DistClusteringAlgorithm(enum.Enum):
     """Distributed coarsening clusterer (reference: dist
-    ClusteringAlgorithm, dkaminpar.h:73-78; GLOBAL_HEM/GLOBAL_HEM_LP are
-    covered by the shm HEM redesign + GLOBAL_LP)."""
+    ClusteringAlgorithm, dkaminpar.h:73-78)."""
 
     GLOBAL_LP = "global-lp"
     # Shard-local clusters only: exchange-free, conflict-free rounds
@@ -46,6 +45,12 @@ class DistClusteringAlgorithm(enum.Enum):
     # LOCAL_LP rounds first, then GLOBAL_LP rounds on what remains — the
     # cheap-first pairing the reference uses LOCAL_LP for.
     LOCAL_GLOBAL_LP = "local-global-lp"
+    # Handshake heavy-edge matching across shards (hem_clusterer.cc; pairs
+    # may span shards — dist/hem.py).
+    GLOBAL_HEM = "global-hem"
+    # HEM pass first, then GLOBAL_LP growing the matched pairs
+    # (hem_lp_clusterer.cc).
+    GLOBAL_HEM_LP = "global-hem-lp"
 
 
 class RefinementAlgorithm(enum.Enum):

@@ -89,7 +89,8 @@ def _run(args) -> int:
         jax.config.update("jax_enable_x64", True)
 
     t0 = time.perf_counter()
-    graph = kio.read_graph(args.graph, args.format, use_64bit=ctx.use_64bit_ids)
+    graph = kio.read_graph(args.graph, args.format, use_64bit=ctx.use_64bit_ids,
+                           decompress=True)
     Logger.log(
         f"Input graph: n={graph.n} m={graph.m // 2} "
         f"(read in {time.perf_counter() - t0:.2f}s); mesh={num} shards "

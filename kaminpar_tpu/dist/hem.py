@@ -1,0 +1,132 @@
+"""Distributed heavy-edge matching (HEM) clusterer.
+
+Reference: ``kaminpar-dist/coarsening/clustering/hem/hem_clusterer.cc``
+(555 LoC) — matching rounds serialized through a distributed graph
+coloring.  The TPU redesign keeps the shm handshake formulation
+(coarsening/hem_clusterer.py): every unmatched node proposes to its
+heaviest eligible neighbor, mutual proposals match.  Cross-shard pairs need
+no coloring and no owner routing — two ghost exchanges per round (partner
+state in, proposals back) make both sides of every cut edge see the same
+handshake, and matches are mutual by construction.
+
+Pairs may span shards; the cluster label is the pair's minimum global id,
+which the global contraction pipeline already handles (clusters owned by
+the min-id's shard).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .exchange import AXIS, ghost_exchange
+from .lp import _neighbor_labels
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _hem_round_body(key, match_loc, node_w, edge_u, col_loc, edge_w, max_cw,
+                    send_idx, recv_map):
+    idx = jax.lax.axis_index(AXIS)
+    kr = jax.random.fold_in(key, idx)
+    n_loc = match_loc.shape[0]
+    base = idx.astype(match_loc.dtype) * n_loc
+    gid = base + jnp.arange(n_loc, dtype=match_loc.dtype)
+    unmatched = (match_loc == gid) & (node_w > 0)
+
+    fill = jnp.asarray(-1, match_loc.dtype)
+    g_match = ghost_exchange(match_loc, send_idx, recv_map, fill=fill)
+    g_gid = ghost_exchange(gid, send_idx, recv_map, fill=fill)
+    g_w = ghost_exchange(node_w, send_idx, recv_map,
+                         fill=jnp.asarray(0, node_w.dtype))
+
+    nbr_gid = _neighbor_labels(gid, g_gid, col_loc, -1)
+    nbr_w = _neighbor_labels(node_w, g_w, col_loc, 0)
+    nbr_match = _neighbor_labels(match_loc, g_match, col_loc, -2)
+    nbr_unmatched = (nbr_match == nbr_gid) & (nbr_w > 0)
+
+    u = edge_u
+    ok = (
+        unmatched[u]
+        & nbr_unmatched
+        & (edge_w > 0)
+        & (node_w[u] + nbr_w <= max_cw)
+        & (nbr_gid != gid[u])
+    )
+
+    # Heaviest eligible neighbor, random tie-break (two segment-argmax
+    # passes — same scheme as the shm handshake, hem_clusterer.py).
+    w_ok = jnp.where(ok, edge_w, -1)
+    best_w = jax.ops.segment_max(w_ok, u, num_segments=n_loc)
+    at_max = ok & (w_ok == best_w[u]) & (best_w[u] > 0)
+    jitter = jax.random.randint(kr, edge_w.shape, 0, _I32MAX, dtype=jnp.int32)
+    j_ok = jnp.where(at_max, jitter, -1)
+    best_j = jax.ops.segment_max(j_ok, u, num_segments=n_loc)
+    is_best = at_max & (j_ok == best_j[u])
+    slot = jnp.arange(u.shape[0], dtype=jnp.int32)
+    first = jax.ops.segment_min(
+        jnp.where(is_best, slot, _I32MAX), u, num_segments=n_loc
+    )
+    has_prop = first < _I32MAX
+    safe = jnp.clip(first, 0, max(u.shape[0] - 1, 0))
+    prop = jnp.where(has_prop, nbr_gid[safe], gid).astype(match_loc.dtype)
+
+    # Handshake: neighbor's proposal must point back.  (Proposals are
+    # deterministic per shard; the exchange makes both sides agree.)
+    g_prop = ghost_exchange(prop, send_idx, recv_map, fill=fill)
+    nbr_prop = _neighbor_labels(prop, g_prop, col_loc, -3)
+    shake = ok & (prop[u] == nbr_gid) & (nbr_prop == gid[u])
+    partner = jax.ops.segment_max(
+        jnp.where(shake, nbr_gid, -1), u, num_segments=n_loc
+    )
+    hit = (partner >= 0) & unmatched
+    new_match = jnp.where(hit, partner.astype(match_loc.dtype), match_loc)
+    num_matched = jax.lax.psum(jnp.sum(hit).astype(jnp.int32), AXIS)
+    return new_match, num_matched
+
+
+@lru_cache(maxsize=None)
+def make_dist_hem_round(mesh: Mesh):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
+                  P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, match, node_w, edge_u, col_loc, edge_w, max_cw,
+                 send_idx, recv_map):
+        return _hem_round_body(
+            key, match, node_w, edge_u, col_loc, edge_w, max_cw,
+            send_idx, recv_map,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_hem_cluster(mesh, key, graph, max_cw, *, num_rounds: int = 5):
+    """Distributed HEM clustering; returns (labels, num_pairs) with
+    labels = min(own gid, partner gid), singletons for unmatched nodes.
+    Both endpoints of a pair register a hit, so the psum'd per-round count
+    is halved."""
+    fn = make_dist_hem_round(mesh)
+    N = graph.N
+    match = jnp.arange(N, dtype=graph.dtype)
+    from .lp import shard_arrays
+
+    match, graph = shard_arrays(mesh, graph, match)
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        match, matched = fn(
+            jax.random.fold_in(key, i), match, graph.node_w, graph.edge_u,
+            graph.col_loc, graph.edge_w, jnp.asarray(max_cw, graph.dtype),
+            graph.send_idx, graph.recv_map,
+        )
+        if int(matched) == 0:
+            break
+        total = total + matched
+    labels = jnp.minimum(match, jnp.arange(N, dtype=graph.dtype))
+    return labels, int(total) // 2

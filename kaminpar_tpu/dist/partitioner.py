@@ -106,6 +106,13 @@ class DKaMinPar:
 
                 algo = ctx.coarsening.dist_clustering
                 rounds = ctx.coarsening.lp.num_iterations
+                if algo in (DCA.GLOBAL_HEM, DCA.GLOBAL_HEM_LP):
+                    from .hem import dist_hem_cluster
+
+                    lab, _ = dist_hem_cluster(
+                        self.mesh, RandomState.next_key(), cur, max_cw,
+                        num_rounds=rounds,
+                    )
                 if algo in (DCA.LOCAL_LP, DCA.LOCAL_GLOBAL_LP):
                     from .lp import dist_local_cluster_iterate
 
@@ -113,7 +120,8 @@ class DKaMinPar:
                         self.mesh, RandomState.next_key(), lab, cur,
                         jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
                     )
-                if algo in (DCA.GLOBAL_LP, DCA.LOCAL_GLOBAL_LP):
+                if algo in (DCA.GLOBAL_LP, DCA.LOCAL_GLOBAL_LP,
+                            DCA.GLOBAL_HEM_LP):
                     lab, _ = dist_cluster_iterate(
                         self.mesh, RandomState.next_key(), lab, cur,
                         jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,

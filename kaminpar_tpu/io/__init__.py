@@ -50,7 +50,13 @@ def read_graph(
     file_format: GraphFileFormat | str | None = None,
     *,
     use_64bit: bool = False,
-) -> CSRGraph:
+    decompress: bool = False,
+):
+    """Returns a CSRGraph — or, for the COMPRESSED format, a CompressedGraph
+    (the facade partitions it directly without materializing the CSR;
+    reference: read_graph's compress flag, kaminpar_io.h:22-54).  Pass
+    ``decompress=True`` when the caller needs CSR arrays unconditionally
+    (dist pipeline, tools)."""
     if file_format is None:
         file_format = _detect(path)
     elif isinstance(file_format, str):
@@ -58,7 +64,8 @@ def read_graph(
     if file_format == GraphFileFormat.METIS:
         return read_metis(path, use_64bit=use_64bit)
     if file_format == GraphFileFormat.COMPRESSED:
-        return read_compressed(path)
+        cg = read_compressed(path)
+        return cg.decompress() if decompress else cg
     return read_parhip(path, use_64bit=use_64bit)
 
 

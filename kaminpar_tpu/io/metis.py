@@ -80,7 +80,14 @@ def read_metis(path: str, *, use_64bit: bool = False) -> CSRGraph:
 
     header_mask = line == line[0]
     header = values[header_mask]
+    # Same hardening as the native parser (parse results must not depend on
+    # which parser ran): a one-token header errors, and header claims are
+    # sanity-bounded by the file size before any allocation.
+    if header.size < 2:
+        raise ValueError(f"{path}: malformed header")
     n, m_undirected = int(header[0]), int(header[1])
+    if n > len(data) + 1 or 2 * m_undirected > len(data):
+        raise ValueError(f"{path}: malformed header")
     fmt = int(header[2]) if header.size > 2 else 0
     has_ew = fmt % 10 == 1
     has_nw = (fmt // 10) % 10 == 1

@@ -10,7 +10,7 @@ import numpy as np
 def _read(path: str):
     from ..io import read_graph
 
-    return read_graph(path)
+    return read_graph(path, decompress=True)
 
 
 def graph_properties(argv) -> int:

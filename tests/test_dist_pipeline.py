@@ -146,15 +146,17 @@ def test_dkaminpar_cli_entry(tmp_path):
     assert set(np.unique(part)) <= set(range(4))
 
 
-def test_dist_local_global_clustering_pipeline():
-    """LOCAL_GLOBAL_LP coarsening (reference pairs LOCAL_LP with global
-    rounds) through the full dist pipeline."""
+@pytest.mark.parametrize("algo", ["local-global-lp", "global-hem-lp"])
+def test_dist_alternative_clusterers_pipeline(algo):
+    """LOCAL_GLOBAL_LP (LOCAL_LP paired with global rounds) and
+    GLOBAL_HEM_LP (handshake matching + LP growth) through the full dist
+    pipeline (reference: dist ClusteringAlgorithm, dkaminpar.h:73-78)."""
     from kaminpar_tpu.context import DistClusteringAlgorithm
     from kaminpar_tpu.presets import create_context_by_preset_name
 
     mesh = _mesh()
     ctx = create_context_by_preset_name("default")
-    ctx.coarsening.dist_clustering = DistClusteringAlgorithm.LOCAL_GLOBAL_LP
+    ctx.coarsening.dist_clustering = DistClusteringAlgorithm(algo)
     g = generators.rmat_graph(10, 8, seed=9)
     k = 8
     solver = DKaMinPar(mesh, ctx)
