@@ -146,6 +146,29 @@ def test_dkaminpar_cli_entry(tmp_path):
     assert set(np.unique(part)) <= set(range(4))
 
 
+def test_dist_kway_scheme():
+    """dist k-way scheme (reference: kway_multilevel.cc): coarsen to C*k,
+    direct k-way IP on the replicated coarsest, refine up — no extension."""
+    from kaminpar_tpu.context import PartitioningMode
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    mesh = _mesh()
+    ctx = create_context_by_preset_name("default")
+    ctx.mode = PartitioningMode.KWAY
+    ctx.coarsening.contraction_limit = 32
+    g = generators.rmat_graph(11, 8, seed=4)
+    k = 8
+    solver = DKaMinPar(mesh, ctx)
+    part = solver.compute_partition(g, k=k)
+    assert part.shape == (g.n,)
+    assert len(np.unique(part)) == k
+    w = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
+    limit = (1.03 * g.total_node_weight + k - 1) // k + g.max_node_weight
+    assert w.max() <= limit
+    rng = np.random.default_rng(0)
+    assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
+
+
 @pytest.mark.parametrize("algo", ["local-global-lp", "global-hem-lp"])
 def test_dist_alternative_clusterers_pipeline(algo):
     """LOCAL_GLOBAL_LP (LOCAL_LP paired with global rounds) and
