@@ -315,12 +315,15 @@ class FMContext:
 
 class MoveExecutionStrategy(enum.Enum):
     """Distributed LP move commitment (reference:
-    LabelPropagationMoveExecutionStrategy, dkaminpar.h:116-120; LOCAL_MOVES
-    has no analog — bulk-synchronous rounds have no PE-local view to apply
-    eagerly)."""
+    LabelPropagationMoveExecutionStrategy, dkaminpar.h:116-120).
+    LOCAL_MOVES is the bulk-synchronous analog of the reference's eager
+    PE-local application: departures are credited to their block's
+    capacity before arrivals are admitted (best-gain-first), so high-churn
+    rounds move strictly more weight than BEST_MOVES."""
 
     PROBABILISTIC = "probabilistic"
     BEST_MOVES = "best-moves"
+    LOCAL_MOVES = "local-moves"
 
 
 @dataclass

@@ -99,6 +99,20 @@ class DKaMinPar:
             )
         dtype = np.int64 if ctx.use_64bit_ids else np.int32
         dg = distribute_graph(graph, P, dtype=dtype)
+
+        # Per-shard load table — the reference's aggregated dist timer rows
+        # (kaminpar-dist/timer.cc:106-173); see dist/shard_stats.py for why
+        # the SPMD analog aggregates work quantities, not wall time.
+        # Collected here, before shard_arrays, while the arrays are still
+        # host-resident (afterwards it would be a full device->host gather),
+        # and only when the table will actually be shown.
+        self.shard_stats = None
+        if Logger.level >= OutputLevel.DEBUG:
+            from .shard_stats import collect_graph_stats
+
+            self.shard_stats = collect_graph_stats(dg)
+            Logger.log(self.shard_stats.render(), OutputLevel.DEBUG)
+
         labels = jnp.arange(dg.N, dtype=dg.dtype)
         labels, dg = shard_arrays(self.mesh, dg, labels)
 
@@ -370,21 +384,28 @@ class DKaMinPar:
         )
         if not feasible:
             Logger.log(
-                "dist balancer exhausted its round budget without restoring "
-                "feasibility; the returned partition may exceed block caps",
-                OutputLevel.WARNING,
+                "WARNING: dist balancer exhausted its round budget without "
+                "restoring feasibility; the returned partition may exceed "
+                "block caps",
+                OutputLevel.PROGRESS,
             )
         from ..context import MoveExecutionStrategy, RefinementAlgorithm
 
-        if (
-            self.ctx.refinement.dist_move_execution
-            == MoveExecutionStrategy.BEST_MOVES
+        if self.ctx.refinement.dist_move_execution in (
+            MoveExecutionStrategy.BEST_MOVES,
+            MoveExecutionStrategy.LOCAL_MOVES,
         ):
-            from .lp import dist_lp_round_best
+            from .lp import dist_lp_round_best, dist_lp_round_local
 
+            round_fn = (
+                dist_lp_round_best
+                if self.ctx.refinement.dist_move_execution
+                == MoveExecutionStrategy.BEST_MOVES
+                else dist_lp_round_local
+            )
             out = part
             for _ in range(self.ctx.refinement.lp.num_iterations):
-                out, moved = dist_lp_round_best(
+                out, moved = round_fn(
                     self.mesh, RandomState.next_key(), out, dgraph, cap,
                     num_labels=k,
                 )

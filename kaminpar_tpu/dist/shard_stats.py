@@ -1,0 +1,114 @@
+"""Per-shard min/mean/max statistics — the dist timer-aggregation analog.
+
+The reference annotates every distributed timer-tree node with min/mean/max
+over MPI ranks (kaminpar-dist/timer.cc:106-173): with one process per PE,
+per-rank wall time *is* the load-imbalance signal, and the aggregated table
+is how imbalance gets diagnosed.  Under SPMD/shard_map there is one host
+program and one fused XLA program for all shards, so per-shard wall time is
+not a host observable — XLA owns the schedule.  What the reference's table
+is *used for* maps instead onto the per-shard work quantities that rank
+wall time proxies there: owned nodes/edges, ghost and interface sizes, and
+per-phase move counts.  ``ShardStats`` collects those and renders the same
+``min / mean / max (imb)`` rows the reference prints, per pipeline phase.
+
+Divergence note: a per-shard *time* column would require one dispatch per
+shard (defeating SPMD) or on-device clocks (not exposed by XLA); the work
+table plus the host timer tree together cover the reference's use cases.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["ShardStats", "collect_graph_stats"]
+
+
+class ShardStats:
+    """Named (P,) per-shard samples with min/mean/max(+imbalance) rendering.
+
+    ``imb`` is max/mean — the reference's convention for reporting load
+    imbalance (a perfectly balanced quantity reads 1.00).
+    """
+
+    def __init__(self, num_shards: int):
+        self.num_shards = num_shards
+        self._rows: Dict[str, np.ndarray] = {}
+        self._order: List[str] = []
+
+    def record(self, name: str, values: Sequence[float]) -> None:
+        arr = np.asarray(values, dtype=np.float64).reshape(-1)
+        if arr.shape[0] != self.num_shards:
+            raise ValueError(
+                f"expected {self.num_shards} per-shard values for {name!r}, "
+                f"got {arr.shape[0]}"
+            )
+        if name not in self._rows:
+            self._order.append(name)
+            self._rows[name] = arr
+        else:  # accumulate repeated phases (e.g. moves per round)
+            self._rows[name] = self._rows[name] + arr
+
+    def stats(self, name: str) -> dict:
+        arr = self._rows[name]
+        mean = float(arr.mean())
+        return {
+            "min": float(arr.min()),
+            "mean": mean,
+            "max": float(arr.max()),
+            "imb": float(arr.max() / mean) if mean > 0 else 1.0,
+        }
+
+    def render(self) -> str:
+        if not self._order:
+            return "(no shard statistics recorded)"
+        width = max(len(n) for n in self._order)
+        lines = [
+            f"shard statistics over {self.num_shards} shards "
+            "(min / mean / max, imb = max/mean):"
+        ]
+        for name in self._order:
+            s = self.stats(name)
+            lines.append(
+                f"  {name:<{width}}  {s['min']:>12.1f} / {s['mean']:>12.1f} / "
+                f"{s['max']:>12.1f}  (imb {s['imb']:.2f})"
+            )
+        return "\n".join(lines)
+
+    def machine_readable(self) -> str:
+        """One SHARDSTAT line per row (greppable, like TIME/RESULT lines)."""
+        out = []
+        for name in self._order:
+            s = self.stats(name)
+            out.append(
+                f"SHARDSTAT {name} min={s['min']:.1f} mean={s['mean']:.1f} "
+                f"max={s['max']:.1f} imb={s['imb']:.4f}"
+            )
+        return "\n".join(out)
+
+
+def collect_graph_stats(dgraph) -> ShardStats:
+    """Static layout statistics of a DistGraph: the load table the reference
+    prints when a distributed graph is read (nodes/edges/ghosts per PE)."""
+    P = dgraph.num_shards
+    n_loc = dgraph.n_loc
+    st = ShardStats(P)
+
+    owned = np.array(
+        [max(0, min((s + 1) * n_loc, dgraph.n) - s * n_loc) for s in range(P)],
+        dtype=np.float64,
+    )
+    st.record("owned_nodes", owned)
+    edge_w = np.asarray(dgraph.edge_w).reshape(P, dgraph.m_loc)
+    st.record("owned_edges", (edge_w > 0).sum(axis=1))
+    st.record("ghost_nodes", [len(g) for g in dgraph.ghost_global])
+    # interface = owned nodes referenced by at least one other shard
+    # (send_idx rows (t*P+s) hold the slots shard t sends to shard s;
+    # pad slots hold n_loc).
+    send = np.asarray(dgraph.send_idx).reshape(P, P, dgraph.cap_g)
+    iface = [
+        len(np.unique(send[t][send[t] < n_loc])) for t in range(P)
+    ]
+    st.record("interface_nodes", iface)
+    return st

@@ -343,3 +343,66 @@ def test_dist_best_moves_round():
     assert after < before, (after, before)
     bw = dist_block_weights(mesh, out, dg, k=k)
     assert (bw <= np.asarray(cap)).all(), bw
+
+
+def test_dist_local_moves_round():
+    """LOCAL_MOVES strategy (dkaminpar.h:116-120): eager commit of every
+    positive-gain mover, caps restored by the rollback fixpoint."""
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import dist_lp_round_local, shard_arrays
+    from kaminpar_tpu.dist.metrics import dist_block_weights, dist_edge_cut
+    from kaminpar_tpu.graph import generators
+
+    mesh = _mesh()
+    g = generators.rgg2d_graph(1024, seed=13)
+    k = 4
+    rng = np.random.default_rng(13)
+    part = rng.integers(0, k, g.n).astype(np.int32)
+    dg = distribute_graph(g, mesh.size)
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[: g.n] = part
+    part_dev, dg = shard_arrays(mesh, dg, jnp.asarray(full))
+    W = int(np.asarray(g.node_w).sum())
+    cap = jnp.full(k, int(np.ceil(W / k) * 1.1) + 1, dtype=dg.dtype)
+    before = dist_edge_cut(mesh, part_dev, dg, k=k)
+    out, moved = dist_lp_round_local(
+        mesh, jax.random.PRNGKey(2), part_dev, dg, cap, num_labels=k
+    )
+    after = dist_edge_cut(mesh, out, dg, k=k)
+    assert int(moved) > 0
+    assert after < before, (after, before)
+    bw = dist_block_weights(mesh, out, dg, k=k)
+    assert (bw <= np.asarray(cap)).all(), bw
+
+
+def test_shard_stats_aggregation():
+    """Per-shard min/mean/max load table — the dist timer-aggregation analog
+    (kaminpar-dist/timer.cc:106-173); totals must match the real graph."""
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.shard_stats import ShardStats, collect_graph_stats
+
+    g = generators.rgg2d_graph(512, seed=3)
+    P = 8
+    dg = distribute_graph(g, P)
+    st = collect_graph_stats(dg)
+    assert int(np.sum(st._rows["owned_nodes"])) == g.n
+    assert int(np.sum(st._rows["owned_edges"])) == g.m
+    s = st.stats("owned_nodes")
+    assert s["min"] <= s["mean"] <= s["max"]
+    assert s["imb"] >= 1.0
+    # ghosts/interface are bounded by what exists
+    assert st.stats("ghost_nodes")["max"] <= g.n
+    assert st.stats("interface_nodes")["max"] <= dg.n_loc
+    txt = st.render()
+    assert "owned_edges" in txt and "imb" in txt
+    mr = st.machine_readable()
+    assert mr.count("SHARDSTAT") == 4
+
+    # repeated record() accumulates (per-round phase counters)
+    acc = ShardStats(2)
+    acc.record("moves", [1, 2])
+    acc.record("moves", [3, 4])
+    assert acc.stats("moves") == {"min": 4.0, "mean": 5.0, "max": 6.0,
+                                  "imb": 1.2}
+    with pytest.raises(ValueError):
+        acc.record("bad", [1, 2, 3])

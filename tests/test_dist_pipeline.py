@@ -429,7 +429,8 @@ def test_dist_validate_partition():
     assert not ok and any("range" in p for p in problems), problems
 
 
-def test_dist_pipeline_best_moves_strategy():
+@pytest.mark.parametrize("strategy", ["best-moves", "local-moves"])
+def test_dist_pipeline_move_execution_strategies(strategy):
     import numpy as np
 
     from kaminpar_tpu.context import MoveExecutionStrategy
@@ -438,7 +439,7 @@ def test_dist_pipeline_best_moves_strategy():
     from kaminpar_tpu.presets import create_context_by_preset_name
 
     ctx = create_context_by_preset_name("default")
-    ctx.refinement.dist_move_execution = MoveExecutionStrategy.BEST_MOVES
+    ctx.refinement.dist_move_execution = MoveExecutionStrategy(strategy)
     ctx.coarsening.contraction_limit = 128
     g = generators.rgg2d_graph(1024, seed=15)
     k = 4
