@@ -317,9 +317,9 @@ class MoveExecutionStrategy(enum.Enum):
     """Distributed LP move commitment (reference:
     LabelPropagationMoveExecutionStrategy, dkaminpar.h:116-120).
     LOCAL_MOVES is the bulk-synchronous analog of the reference's eager
-    PE-local application: departures are credited to their block's
-    capacity before arrivals are admitted (best-gain-first), so high-churn
-    rounds move strictly more weight than BEST_MOVES."""
+    PE-local application: proposals ignore block caps and departures are
+    credited to their block's capacity before arrivals are admitted
+    (best-gain-first), so swaps between at-cap blocks stay reachable."""
 
     PROBABILISTIC = "probabilistic"
     BEST_MOVES = "best-moves"

@@ -752,8 +752,11 @@ def _best_moves_commit(
 
 def _best_refine_round_body(
     key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
-    recv_map, *, num_labels: int
+    recv_map, *, num_labels: int, eager: bool = False
 ):
+    """BEST_MOVES round; with ``eager`` the LOCAL_MOVES variant (see the
+    section comment below): proposals ignore block caps and admission runs
+    against leaver-credited capacity."""
     idx = jax.lax.axis_index(AXIS)
     kshard = jax.random.fold_in(key, idx)
     kr, kp = jax.random.split(kshard)
@@ -767,19 +770,31 @@ def _best_refine_round_body(
     target, tconn, own_conn, _ = flat_best_moves(
         kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
         cluster_w, max_w, num_rows=n_loc,
-        external_only=False, respect_caps=True,
+        external_only=False, respect_caps=not eager,
     )
     gain = tconn - own_conn
     desired = jnp.where(gain > 0, target, labels_loc)
     mover = desired != labels_loc
+    admit_w = cluster_w
+    if eager:
+        leaving = jax.lax.psum(
+            jax.ops.segment_sum(
+                jnp.where(mover, node_w_loc, 0),
+                labels_loc.astype(jnp.int32),
+                num_segments=num_labels,
+            ),
+            AXIS,
+        )
+        admit_w = cluster_w - leaving
     return _best_moves_commit(
-        kp, mover, desired, gain, labels_loc, node_w_loc, max_w, cluster_w,
+        kp, mover, desired, gain, labels_loc, node_w_loc, max_w, admit_w,
         num_labels,
     )
 
 
 @lru_cache(maxsize=None)
-def make_dist_lp_round_best(mesh: Mesh, *, num_labels: int):
+def make_dist_lp_round_best(mesh: Mesh, *, num_labels: int,
+                            eager: bool = False):
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -791,7 +806,7 @@ def make_dist_lp_round_best(mesh: Mesh, *, num_labels: int):
                  send_idx, recv_map):
         return _best_refine_round_body(
             key, labels, node_w, edge_u, col_loc, edge_w, max_w,
-            send_idx, recv_map, num_labels=num_labels,
+            send_idx, recv_map, num_labels=num_labels, eager=eager,
         )
 
     return jax.jit(round_fn)
@@ -810,76 +825,22 @@ def dist_lp_round_best(mesh, key, labels, graph, max_w, *, num_labels: int):
 # each PE applies its moves to its local partition view *immediately* during
 # the round, so a departure frees its block's capacity for the very next
 # move, and the global state is reconciled afterwards.  Bulk-synchronous
-# analog of that eager visibility: credit every block with the weight of
-# its *leaving* movers up front, then admit arrivals into the freed
-# capacity best-gain-first (the BEST_MOVES gain-histogram threshold, which
-# thins demand to fit); the shared rollback fixpoint backstops the caps.
-# Relative to BEST_MOVES (admission against pre-round weights) this moves
-# strictly more weight in high-churn rounds, which is LOCAL_MOVES' point.
-# A literal commit-all would be wrong here: the rollback fixpoint is
-# all-or-none per block, so unthinned demand collapses to zero moves on
-# any contended block (measured: the round committed nothing on a random
-# rgg2d partition with 10% slack).
+# analog of that eager visibility (shared body above, ``eager=True``):
+# proposals IGNORE block caps (a full block's freed capacity must stay
+# proposable — with caps respected, two at-cap blocks can never swap),
+# every block is credited with the weight of its *leaving* movers, and
+# arrivals are admitted into the credited capacity best-gain-first via the
+# BEST_MOVES gain-histogram threshold; the rollback fixpoint backstops the
+# caps.  A literal commit-all would be wrong here: the rollback fixpoint
+# is all-or-none per block, so unthinned demand collapses to zero moves
+# on any contended block (measured: the round committed nothing on a
+# random rgg2d partition with 10% slack).
 # ---------------------------------------------------------------------------
 
 
-def _local_refine_round_body(
-    key, labels_loc, node_w_loc, edge_u, col_loc, edge_w, max_w, send_idx,
-    recv_map, *, num_labels: int
-):
-    idx = jax.lax.axis_index(AXIS)
-    kshard = jax.random.fold_in(key, idx)
-    kr, kp = jax.random.split(kshard)
-    n_loc = labels_loc.shape[0]
-
-    ghost_labels = ghost_exchange(
-        labels_loc, send_idx, recv_map, fill=jnp.asarray(0, labels_loc.dtype)
-    )
-    cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
-    cluster_w = _global_block_weights(node_w_loc, labels_loc, num_labels)
-    target, tconn, own_conn, _ = flat_best_moves(
-        kr, edge_u, cand, edge_w, labels_loc, node_w_loc,
-        cluster_w, max_w, num_rows=n_loc,
-        external_only=False, respect_caps=True,
-    )
-    gain = tconn - own_conn
-    desired = jnp.where(gain > 0, target, labels_loc)
-    mover = desired != labels_loc
-    leaving = jax.lax.psum(
-        jax.ops.segment_sum(
-            jnp.where(mover, node_w_loc, 0),
-            labels_loc.astype(jnp.int32),
-            num_segments=num_labels,
-        ),
-        AXIS,
-    )
-    return _best_moves_commit(
-        kp, mover, desired, gain, labels_loc, node_w_loc, max_w,
-        cluster_w - leaving, num_labels,
-    )
-
-
-@lru_cache(maxsize=None)
-def make_dist_lp_round_local(mesh: Mesh, *, num_labels: int):
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
-                  P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P()),
-    )
-    def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_w,
-                 send_idx, recv_map):
-        return _local_refine_round_body(
-            key, labels, node_w, edge_u, col_loc, edge_w, max_w,
-            send_idx, recv_map, num_labels=num_labels,
-        )
-
-    return jax.jit(round_fn)
-
-
 def dist_lp_round_local(mesh, key, labels, graph, max_w, *, num_labels: int):
-    """One LOCAL_MOVES refinement round (eager commit + rollback fixpoint)."""
-    fn = make_dist_lp_round_local(mesh, num_labels=num_labels)
+    """One LOCAL_MOVES refinement round (eager proposals, leaver-credited
+    admission, rollback backstop)."""
+    fn = make_dist_lp_round_best(mesh, num_labels=num_labels, eager=True)
     return fn(key, labels, graph.node_w, graph.edge_u, graph.col_loc,
               graph.edge_w, max_w, graph.send_idx, graph.recv_map)

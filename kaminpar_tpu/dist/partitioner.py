@@ -383,11 +383,9 @@ class DKaMinPar:
             self.mesh, RandomState.next_key(), part, dgraph, cap, k=k
         )
         if not feasible:
-            Logger.log(
-                "WARNING: dist balancer exhausted its round budget without "
-                "restoring feasibility; the returned partition may exceed "
-                "block caps",
-                OutputLevel.PROGRESS,
+            Logger.warning(
+                "dist balancer exhausted its round budget without restoring "
+                "feasibility; the returned partition may exceed block caps"
             )
         from ..context import MoveExecutionStrategy, RefinementAlgorithm
 

@@ -1,0 +1,44 @@
+"""C API (capi/): build libkaminpar_tpu.so + the C demo client, run it.
+
+The reference ships a C interface (include/kaminpar-shm/ckaminpar.h); ours
+is a C-linkable shared library embedding CPython (see
+capi/include/kaminpar_tpu.h for the design).  This test is the analog of
+compiling and running a ckaminpar client program.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(REPO, "kaminpar_tpu", "capi")
+
+
+@pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+def test_c_api_demo_client():
+    build = subprocess.run(
+        ["make", "demo"], cwd=CAPI, capture_output=True, text=True
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # strip any site hook, like conftest does
+    env["KPTPU_PYTHON"] = sys.executable
+    run = subprocess.run(
+        [os.path.join(CAPI, "demo")], capture_output=True, text=True,
+        env=env, timeout=900,
+    )
+    assert run.returncode == 0, (run.stdout[-500:], run.stderr[-2000:])
+    assert "CAPI_OK cut=" in run.stdout
+    cut = int(run.stdout.split("cut=")[1].split()[0])
+    # 24x24 grid into 4 blocks: the ideal quarter-cut is 48; anything in
+    # this range is a sane partition, anything far above means the C path
+    # corrupted the graph.
+    assert 40 <= cut <= 120, cut

@@ -406,3 +406,37 @@ def test_shard_stats_aggregation():
                                   "imb": 1.2}
     with pytest.raises(ValueError):
         acc.record("bad", [1, 2, 3])
+
+
+def test_local_moves_swaps_between_at_cap_blocks():
+    """The point of LOCAL_MOVES' eager semantics: freed capacity stays
+    proposable, so two blocks at exact cap can still exchange nodes —
+    BEST_MOVES (cap-respecting proposals) commits nothing here."""
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.dist.lp import (
+        dist_lp_round_best, dist_lp_round_local, shard_arrays,
+    )
+    from kaminpar_tpu.graph.csr import CSRGraph
+
+    mesh = _mesh()
+    # Two nodes joined by one edge, one per block, caps exactly 1.
+    g = CSRGraph(np.array([0, 1, 2]), np.array([1, 0]))
+    k = 2
+    dg = distribute_graph(g, mesh.size)
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[0], full[1] = 0, 1
+    cap = jnp.ones(k, dtype=dg.dtype)
+
+    part_dev, dgs = shard_arrays(mesh, dg, jnp.asarray(full))
+    _, moved_best = dist_lp_round_best(
+        mesh, jax.random.PRNGKey(0), part_dev, dgs, cap, num_labels=k
+    )
+    assert int(moved_best) == 0
+
+    out, moved_local = dist_lp_round_local(
+        mesh, jax.random.PRNGKey(0), part_dev, dgs, cap, num_labels=k
+    )
+    assert int(moved_local) > 0
+    out = np.asarray(out)[:2]
+    bw = np.bincount(out, minlength=k)
+    assert (bw <= 1).all(), bw
