@@ -29,7 +29,8 @@ struct kptpu_solver {
 namespace {
 
 std::mutex g_init_mutex;
-bool g_initialized = false;
+bool g_py_inited = false;  /* interpreter started (irreversible until finalize) */
+bool g_finalized = false;  /* finalize called — library is dead for good */
 PyObject *g_bridge = nullptr;          /* kaminpar_tpu.capi_bridge module */
 PyThreadState *g_main_state = nullptr; /* released after init for GIL use */
 thread_local std::string g_last_error;
@@ -59,28 +60,8 @@ struct GilGuard {
   ~GilGuard() { PyGILState_Release(state); }
 };
 
-int initialize_locked(const char *repo_path) {
-  if (g_initialized) return 0;
-
-  PyConfig config;
-  PyConfig_InitPythonConfig(&config);
-  /* Point the runtime at the interpreter that owns the site-packages with
-   * jax/numpy (a venv python makes getpath honor its pyvenv.cfg).  The
-   * build bakes in a default; $KPTPU_PYTHON overrides at runtime. */
-  const char *py = getenv("KPTPU_PYTHON");
-  if (!py || !*py) py = KPTPU_DEFAULT_PYTHON;
-  if (py && *py) {
-    PyConfig_SetBytesString(&config, &config.executable, py);
-  }
-  PyStatus status = Py_InitializeFromConfig(&config);
-  PyConfig_Clear(&config);
-  if (PyStatus_Exception(status)) {
-    g_last_error = std::string("Py_InitializeFromConfig failed: ") +
-                   (status.err_msg ? status.err_msg : "unknown");
-    return -1;
-  }
-
-  /* Make `kaminpar_tpu` importable. */
+/* Prepend the kaminpar_tpu repo to sys.path (GIL must be held). */
+void add_repo_path(const char *repo_path) {
   const char *repo = repo_path && *repo_path ? repo_path : getenv("KPTPU_REPO");
   if (!repo || !*repo) repo = KPTPU_DEFAULT_REPO;
   if (repo && *repo) {
@@ -89,16 +70,53 @@ int initialize_locked(const char *repo_path) {
     if (sys_path && entry) PyList_Insert(sys_path, 0, entry);
     Py_XDECREF(entry);
   }
+}
 
+int initialize_locked(const char *repo_path) {
+  if (g_finalized) {
+    g_last_error = "kptpu_finalize was called; the library cannot be "
+                   "re-initialized in this process (CPython limitation)";
+    return -1;
+  }
+  if (g_bridge) return 0;
+
+  if (!g_py_inited) {
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    /* Point the runtime at the interpreter that owns the site-packages
+     * with jax/numpy (a venv python makes getpath honor its pyvenv.cfg).
+     * The build bakes in a default; $KPTPU_PYTHON overrides at runtime. */
+    const char *py = getenv("KPTPU_PYTHON");
+    if (!py || !*py) py = KPTPU_DEFAULT_PYTHON;
+    if (py && *py) {
+      PyConfig_SetBytesString(&config, &config.executable, py);
+    }
+    PyStatus status = Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    if (PyStatus_Exception(status)) {
+      g_last_error = std::string("Py_InitializeFromConfig failed: ") +
+                     (status.err_msg ? status.err_msg : "unknown");
+      return -1;
+    }
+    g_py_inited = true;
+    add_repo_path(repo_path);
+    g_bridge = PyImport_ImportModule("kaminpar_tpu.capi_bridge");
+    if (!g_bridge) capture_py_error("import kaminpar_tpu.capi_bridge failed");
+    /* ALWAYS release the GIL, even on import failure — a held GIL would
+     * deadlock every later call from another thread.  The import is
+     * retried (e.g. after kptpu_initialize with a correct repo path). */
+    g_main_state = PyEval_SaveThread();
+    return g_bridge ? 0 : -1;
+  }
+
+  /* Interpreter is live but the bridge import failed earlier — retry. */
+  GilGuard gil;
+  add_repo_path(repo_path);
   g_bridge = PyImport_ImportModule("kaminpar_tpu.capi_bridge");
   if (!g_bridge) {
     capture_py_error("import kaminpar_tpu.capi_bridge failed");
     return -1;
   }
-  g_initialized = true;
-  /* Release the GIL so subsequent entry points (any thread) can take it
-   * via PyGILState_Ensure. */
-  g_main_state = PyEval_SaveThread();
   return 0;
 }
 
@@ -125,12 +143,12 @@ int kptpu_initialize(const char *repo_path) {
 
 void kptpu_finalize(void) {
   std::lock_guard<std::mutex> lock(g_init_mutex);
-  if (!g_initialized) return;
+  if (!g_py_inited || g_finalized) return;
   PyEval_RestoreThread(g_main_state);
   Py_XDECREF(g_bridge);
   g_bridge = nullptr;
   Py_FinalizeEx();
-  g_initialized = false;
+  g_finalized = true; /* permanently — see header */
 }
 
 const char *kptpu_last_error(void) { return g_last_error.c_str(); }

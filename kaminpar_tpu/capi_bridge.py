@@ -54,8 +54,12 @@ class CSolver:
         )
         # Device dtype: int32 unless the values need 64 bits (the runtime
         # analog of the reference's KAMINPAR_64BIT_* build switches).
+        # Sums matter, not just maxima: cluster/block weights are
+        # accumulated in this dtype on device, so a total weight >= 2^31
+        # silently wraps under int32 even when every entry is small.
         wide = n >= 2**31 or m >= 2**31 or any(
-            w is not None and w.size and int(np.abs(w).max()) >= 2**31
+            w is not None and w.size
+            and int(np.abs(w).sum(dtype=np.int64)) >= 2**31
             for w in (node_w, edge_w)
         )
         idt = np.int64 if wide else np.int32
@@ -87,6 +91,12 @@ class CSolver:
 
         if self.n == 0:
             raise RuntimeError("no graph set (call kptpu_copy_graph first)")
+        for name, bw in (("max", self.max_block_weights),
+                         ("min", self.min_block_weights)):
+            if bw is not None and len(bw) != int(k):
+                raise ValueError(
+                    f"{name}_block_weights has {len(bw)} entries but k={int(k)}"
+                )
         out = np.frombuffer(out_mv, dtype=np.uint32)
         if out.shape[0] != self.n:  # fail before the multi-second pipeline
             raise ValueError(

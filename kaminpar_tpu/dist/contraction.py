@@ -276,9 +276,21 @@ def contract_dist_clustering(
 
     edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
 
-    # Host: localize edge targets + build the coarse ghost routing.  The
-    # edge sources out of _s3 are ALREADY shard-local (cu_l subtraction in
-    # the aggregation body) — do not localize them again.
+    coarse = _assemble_coarse(
+        edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c,
+        n_loc_c=n_loc_c, m_loc_c=m_loc_c, num_shards=Pn,
+    )
+    return coarse, coarse_of, n_c
+
+
+def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c, *,
+                     n_loc_c: int, m_loc_c: int, num_shards: int) -> DistGraph:
+    """Host tail shared by global and local contraction: localize edge
+    targets + build the coarse ghost routing (O(m_c) host work on a
+    geometrically shrinking series).  The edge sources are ALREADY
+    shard-local (cu_l subtraction in the aggregation bodies) — do not
+    localize them again."""
+    Pn = num_shards
     m_total = int(np.sum(np.asarray(m_c_loc)))
     eu_l = np.asarray(edge_u_g).reshape(Pn, m_loc_c)
     cv_g = np.asarray(col_g).reshape(Pn, m_loc_c)
@@ -300,11 +312,11 @@ def contract_dist_clustering(
         ]
     )
 
-    coarse = DistGraph(
-        node_w=node_w_c.reshape(-1),
+    return DistGraph(
+        node_w=jnp.asarray(node_w_c).reshape(-1),
         edge_u=jnp.asarray(edge_u_c.reshape(-1)),
         col_loc=jnp.asarray(col_loc_c.reshape(-1)),
-        edge_w=edge_w_c.reshape(-1),
+        edge_w=jnp.asarray(edge_w_c).reshape(-1),
         send_idx=jnp.asarray(send_idx),
         recv_map=jnp.asarray(recv_map),
         ghost_global=tuple(ghost_global),
@@ -316,7 +328,6 @@ def contract_dist_clustering(
         cap_g=cap_g,
         num_shards=Pn,
     )
-    return coarse, coarse_of, n_c
 
 
 def project_partition_up(mesh, coarse_of, coarse_part, *, n_loc_c: int,
@@ -351,3 +362,186 @@ def project_partition_up(mesh, coarse_of, coarse_part, *, n_loc_c: int,
             break
         cap_q = min(cap_q * 2, n_loc_f)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Local contraction.  Reference: kaminpar-dist/coarsening/contraction/
+# local_contraction.cc — when the clustering is shard-local (every cluster
+# id is owned by the node's own shard, e.g. the LOCAL_LP clusterer), the
+# expensive cluster-resolution machinery of the global path disappears:
+# compaction is a per-shard rank (no owner_aggregate), neighbor coarse ids
+# arrive with ONE ghost exchange (no two-phase owner_query), and edges are
+# aggregated in-shard BEFORE the migration all-to-all, which then carries
+# m_c_loc (deduplicated) instead of m_loc entries.  The output uses the
+# same contiguous coarse layout as the global path — coarse ids are
+# exscan(count) + rank, so the prefix-dense invariant ("real iff global id
+# < n") that dist_color/_replicate_to_host/extension all rely on keeps
+# holding; a shard-resident coarse layout (holes between shards) was tried
+# first and silently lost ~25% of the node weight per level through that
+# invariant.  _l2 therefore emits exactly _s2's output contract and the
+# shared _s3/_s4/_assemble_coarse tail finishes the job.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_loc", "n_real"))
+def _l1(mesh, labels, node_w, *, n_loc: int, n_real: int):
+    """Per-shard cluster weights + compact ranks + counts + locality check."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
+    )
+    def body(labels_loc, node_w_loc):
+        idx = jax.lax.axis_index(AXIS)
+        base = idx.astype(labels_loc.dtype) * n_loc
+        real = base + jnp.arange(n_loc, dtype=labels_loc.dtype) < n_real
+        lab_l = labels_loc - base
+        nonlocal_count = jax.lax.psum(
+            jnp.sum(real & ((lab_l < 0) | (lab_l >= n_loc))).astype(jnp.int32),
+            AXIS,
+        )
+        lab_c = jnp.clip(lab_l, 0, n_loc - 1).astype(jnp.int32)
+        cw = jax.ops.segment_sum(
+            jnp.where(real, node_w_loc, 0), lab_c, num_segments=n_loc
+        )
+        used = cw > 0
+        rank = jnp.cumsum(used.astype(jnp.int32)) - 1
+        count = jnp.sum(used).astype(jnp.int32)
+        return cw, rank, count.reshape(1), nonlocal_count
+
+    return body(labels, node_w)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "n_loc", "n_loc_c", "r_loc", "n_real"))
+def _l2(mesh, labels, rank, cw, bases, edge_u, col_loc, edge_w, send_idx,
+        recv_map, *, n_loc: int, n_loc_c: int, r_loc: int, n_real: int):
+    """Contiguous coarse ids via one ghost exchange + in-shard (cu, cv)
+    sort-reduce + route-by-coarse-owner.  Emits _s2's output contract
+    (without its overflow flag — there is no owner_query to overflow).
+
+    ``bases`` is the (P,) exclusive scan of per-shard cluster counts;
+    ``r_loc`` bounds the per-shard local rank (>= max count, pow2)."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS), P(AXIS),
+                  P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS),) * 8,
+    )
+    def body(labels_loc, rank_loc, cw_loc, bases_all, eu, cl, ew, sidx, rmap):
+        nshards = jax.lax.axis_size(AXIS)
+        idx = jax.lax.axis_index(AXIS)
+        base = idx.astype(labels_loc.dtype) * n_loc
+        base_c = bases_all[idx].astype(labels_loc.dtype)
+        real = base + jnp.arange(n_loc, dtype=labels_loc.dtype) < n_real
+        lab_c = jnp.clip(labels_loc - base, 0, n_loc - 1).astype(jnp.int32)
+        coarse_of = jnp.where(
+            real, base_c + rank_loc[lab_c].astype(labels_loc.dtype), -1
+        )
+
+        ghost_c = ghost_exchange(
+            coarse_of, sidx, rmap, fill=jnp.asarray(-1, coarse_of.dtype)
+        )
+        ext = jnp.concatenate(
+            [coarse_of, ghost_c, jnp.full((1,), -1, coarse_of.dtype)]
+        )
+        g_loc = ghost_c.shape[0]
+        cu = coarse_of[eu]
+        cv = ext[jnp.clip(cl, 0, n_loc + g_loc)]
+        keep = (ew > 0) & (cu != cv) & (cu >= 0) & (cv >= 0)
+
+        # in-shard aggregation by (local rank, cv) — the _s3 sort-reduce
+        # shape, keyed by rank (bounded by r_loc, NOT n_loc_c: a skewed
+        # shard can own more clusters than the contiguous layout's slot
+        # count).
+        S = eu.shape[0]
+        cu_r = cu - base_c
+        key_u = jnp.where(keep, cu_r, r_loc)  # drops sort last
+        su, sv, sw = jax.lax.sort(
+            (key_u, cv, jnp.where(keep, ew, 0)), dimension=0, num_keys=2
+        )
+        first = run_starts2(su, sv)
+        c = jnp.cumsum(sw)
+        run_base = jax.lax.cummax(jnp.where(first, c - sw, 0))
+        end = jnp.concatenate([first[1:], jnp.ones(1, bool)])
+        run_w = jnp.where(end & (su < r_loc), c - run_base, 0)
+        valid_run = end & (su < r_loc) & (run_w > 0)
+
+        # route the aggregated runs by the coarse owner under the
+        # contiguous layout (the _s2 routing block, on m_c_loc entries)
+        cu_g = su + base_c  # back to global contiguous ids
+        dest = jnp.where(valid_run, cu_g // n_loc_c, nshards).astype(jnp.int32)
+        order = jnp.argsort(dest, stable=True)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(dest), dest, num_segments=nshards + 1
+        )[:nshards]
+        s_cu = jnp.where(valid_run, cu_g, 0)[order]
+        s_cv = jnp.where(valid_run, sv, 0)[order]
+        s_w = jnp.where(valid_run, run_w, 0)[order]
+
+        # route coarse node weights by owner of the final id (as in _s2)
+        used = cw_loc > 0
+        final_id = base_c + rank_loc.astype(labels_loc.dtype)
+        wdest = jnp.where(used, final_id // n_loc_c, nshards).astype(jnp.int32)
+        worder = jnp.argsort(wdest, stable=True)
+        wcounts = jax.ops.segment_sum(
+            jnp.ones_like(wdest), wdest, num_segments=nshards + 1
+        )[:nshards]
+        wk = jnp.where(used, final_id, -1)[worder]
+        wv = jnp.where(used, cw_loc, 0)[worder]
+
+        return coarse_of, s_cu, s_cv, s_w, counts, wk, wv, wcounts
+
+    return body(labels, rank, cw, bases, edge_u, col_loc, edge_w,
+                send_idx, recv_map)
+
+
+def contract_local_clustering(
+    mesh: Mesh, graph: DistGraph, labels
+) -> Tuple[DistGraph, jax.Array, int]:
+    """Contract a SHARD-LOCAL clustering (label // n_loc == own shard for
+    every real node; the LOCAL_LP clusterer guarantees this).  Same return
+    contract AND same coarse layout as :func:`contract_dist_clustering` —
+    only cheaper: no owner-routed compaction/queries, and the migration
+    all-to-all carries pre-aggregated edges.  Raises ValueError if the
+    clustering is not local."""
+    Pn = graph.num_shards
+    n_loc = graph.n_loc
+
+    cw, rank, counts, nonlocal_count = _l1(
+        mesh, labels, graph.node_w, n_loc=n_loc, n_real=graph.n
+    )
+    if int(nonlocal_count) > 0:
+        raise ValueError(
+            f"{int(nonlocal_count)} nodes have non-local cluster ids; use "
+            "contract_dist_clustering for clusterings that span shards"
+        )
+    counts = np.asarray(counts)
+    n_c = int(counts.sum())
+    n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
+    r_loc = next_pow2(int(counts.max()), 8)
+    bases = jnp.asarray((np.cumsum(counts) - counts).astype(labels.dtype))
+
+    (coarse_of, s_cu, s_cv, s_w, ecounts, w_keys, w_vals, wcounts) = _l2(
+        mesh, labels, rank, cw, bases, graph.edge_u, graph.col_loc,
+        graph.edge_w, graph.send_idx, graph.recv_map,
+        n_loc=n_loc, n_loc_c=n_loc_c, r_loc=r_loc, n_real=graph.n,
+    )
+    cap = next_pow2(int(np.max(np.asarray(ecounts))), 8)
+    cap_w = next_pow2(int(np.max(np.asarray(wcounts))), 8)
+
+    agg_u, agg_v, agg_w, m_c_loc, node_w_c = _s3(
+        mesh, s_cu, s_cv, s_w, ecounts, w_keys, w_vals, wcounts,
+        num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
+    )
+    m_loc_c = next_pow2(int(np.max(np.asarray(m_c_loc))), 8)
+    m_loc_c = min(m_loc_c, Pn * cap)
+    edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
+
+    coarse = _assemble_coarse(
+        edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c,
+        n_loc_c=n_loc_c, m_loc_c=m_loc_c, num_shards=Pn,
+    )
+    return coarse, coarse_of, n_c

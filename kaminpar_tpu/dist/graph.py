@@ -74,6 +74,37 @@ class DistGraph(NamedTuple):
     def dtype(self):
         return self.node_w.dtype
 
+    def edges_global_host(self):
+        """Host view of all real edges as (src_global, dst_global, weight)
+        numpy arrays — gathers the device shards, localizes ghost slots via
+        ghost_global.  Shared by replicate-to-host and the BFS extractor
+        (keep the subtle slot->global localization in ONE place)."""
+        srcs, dsts, ws = [], [], []
+        eu = np.asarray(self.edge_u).reshape(self.num_shards, self.m_loc)
+        cl = np.asarray(self.col_loc).reshape(self.num_shards, self.m_loc)
+        ew = np.asarray(self.edge_w).reshape(self.num_shards, self.m_loc)
+        for s in range(self.num_shards):
+            real = ew[s] > 0
+            srcs.append(
+                eu[s][real].astype(np.int64) + s * self.n_loc
+            )
+            slots = cl[s][real].astype(np.int64)
+            gg = self.ghost_global[s]
+            is_local = slots < self.n_loc
+            dst = np.where(
+                is_local,
+                slots + s * self.n_loc,
+                gg[np.clip(slots - self.n_loc, 0, max(len(gg) - 1, 0))]
+                if len(gg) else 0,
+            )
+            dsts.append(dst)
+            ws.append(ew[s][real].astype(np.int64))
+        return (
+            np.concatenate(srcs) if srcs else np.zeros(0, np.int64),
+            np.concatenate(dsts) if dsts else np.zeros(0, np.int64),
+            np.concatenate(ws) if ws else np.zeros(0, np.int64),
+        )
+
     @property
     def max_per_shard_array(self) -> int:
         """Largest per-shard device array the layout allocates — the

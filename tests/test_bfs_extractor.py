@@ -1,0 +1,132 @@
+"""Distributed BFS extractor (reference: dist graphutils/bfs_extractor.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kaminpar_tpu.dist.graph import distribute_graph
+from kaminpar_tpu.dist.lp import shard_arrays
+from kaminpar_tpu.graph import generators
+
+
+def _mesh(num=8):
+    devs = jax.devices()
+    if len(devs) < num:
+        pytest.skip(f"need {num} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:num]), ("nodes",))
+
+
+def _np_bfs_hops(g, seeds, radius):
+    rp = np.asarray(g.row_ptr)
+    col = np.asarray(g.col_idx)
+    hops = np.full(g.n, 2**30, dtype=np.int64)
+    hops[list(seeds)] = 0
+    frontier = list(seeds)
+    for h in range(radius):
+        nxt = []
+        for u in frontier:
+            for e in range(rp[u], rp[u + 1]):
+                v = col[e]
+                if hops[v] > h + 1:
+                    hops[v] = h + 1
+                    nxt.append(v)
+        frontier = nxt
+    return hops
+
+
+def test_dist_bfs_hops_match_host_bfs():
+    from kaminpar_tpu.dist.bfs_extractor import dist_bfs_hops
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(16, 16)
+    dg = distribute_graph(g, mesh.size)
+    lab = jnp.zeros(dg.N, dtype=jnp.int32)
+    _, dgs = shard_arrays(mesh, dg, lab)
+    seeds = [0, 255]
+    radius = 5
+    hops = dist_bfs_hops(mesh, dgs, seeds, radius=radius)
+    ref = _np_bfs_hops(g, seeds, radius)
+    # cross-shard propagation must match a host BFS exactly inside the ball
+    assert np.array_equal(hops, np.minimum(ref, 2**30))
+
+
+def test_bfs_extract_contract_exterior():
+    from kaminpar_tpu.dist.bfs_extractor import dist_bfs_extract
+    from kaminpar_tpu.graph.csr import CSRGraph
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(16, 16)
+    k = 4
+    # blocks = quadrants
+    part = np.zeros(g.n, dtype=np.int32)
+    for u in range(g.n):
+        r, c = divmod(u, 16)
+        part[u] = (r >= 8) * 2 + (c >= 8)
+    dg = distribute_graph(g, mesh.size)
+    full = np.zeros(dg.N, dtype=np.int32)
+    full[: g.n] = part
+    lab, dgs = shard_arrays(mesh, dg, jnp.asarray(full))
+
+    res = dist_bfs_extract(mesh, dgs, lab, [0], radius=4, k=k,
+                           exterior="contract")
+    ball = {u for u in range(g.n) if divmod(u, 16)[0] + divmod(u, 16)[1] <= 4}
+    assert set(res.node_mapping.tolist()) == ball
+    assert res.num_region_nodes == len(ball)
+    assert res.graph.n == len(ball) + k
+    # supernode weights carry the exterior block weights
+    ext = res.graph
+    nw = np.asarray(ext.node_w)
+    for b in range(k):
+        outside = sum(1 for u in range(g.n) if part[u] == b and u not in ball)
+        assert nw[res.num_region_nodes + b] == max(outside, 1)
+    # partition of region nodes matches the distributed labels; supernode b
+    # sits in block b
+    assert np.array_equal(res.partition[: res.num_region_nodes],
+                          part[res.node_mapping])
+    assert np.array_equal(res.partition[res.num_region_nodes:], np.arange(k))
+    # the extracted graph is a valid symmetric CSR
+    assert isinstance(ext, CSRGraph)
+    rp = np.asarray(ext.row_ptr)
+    col = np.asarray(ext.col_idx)
+    ew = np.asarray(ext.edge_w)
+    assert rp[-1] == col.shape[0]
+    # symmetry with matching weights
+    pairs = {}
+    for u in range(ext.n):
+        for e in range(rp[u], rp[u + 1]):
+            pairs[(u, int(col[e]))] = int(ew[e])
+    for (u, v), w in pairs.items():
+        assert pairs.get((v, u)) == w, (u, v)
+    # total edge weight: interior edges (both endpoints in ball) counted
+    # once per direction + boundary edges twice (region->super + mirror)
+    grp = np.asarray(g.row_ptr)
+    gcol = np.asarray(g.col_idx)
+    interior = boundary = 0
+    for u in ball:
+        for e in range(grp[u], grp[u + 1]):
+            v = int(gcol[e])
+            if v in ball:
+                interior += 1
+            else:
+                boundary += 1
+    assert int(ew.sum()) == interior + 2 * boundary
+
+
+def test_bfs_extract_exclude_exterior():
+    from kaminpar_tpu.dist.bfs_extractor import dist_bfs_extract
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(12, 12)
+    dg = distribute_graph(g, mesh.size)
+    lab, dgs = shard_arrays(mesh, dg, jnp.zeros(dg.N, dtype=jnp.int32))
+    res = dist_bfs_extract(mesh, dgs, lab, [0, 143], radius=3, k=1,
+                           exterior="exclude")
+    assert res.graph.n == res.num_region_nodes == len(res.node_mapping)
+    # two disjoint balls of radius 3 around opposite corners
+    assert res.graph.n == 2 * len(
+        {u for u in range(144) if sum(divmod(u, 12)) <= 3}
+    )
+    with pytest.raises(ValueError):
+        dist_bfs_extract(mesh, dgs, lab, [0], radius=1, k=1, exterior="bogus")

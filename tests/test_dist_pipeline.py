@@ -169,11 +169,14 @@ def test_dist_kway_scheme():
     assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
 
 
-@pytest.mark.parametrize("algo", ["local-global-lp", "global-hem-lp"])
+@pytest.mark.parametrize("algo", ["local-lp", "local-global-lp",
+                                  "global-hem-lp"])
 def test_dist_alternative_clusterers_pipeline(algo):
-    """LOCAL_GLOBAL_LP (LOCAL_LP paired with global rounds) and
-    GLOBAL_HEM_LP (handshake matching + LP growth) through the full dist
-    pipeline (reference: dist ClusteringAlgorithm, dkaminpar.h:73-78)."""
+    """LOCAL_LP (pure shard-local clustering -> exchange-free local
+    contraction, local_contraction.cc role), LOCAL_GLOBAL_LP (LOCAL_LP
+    paired with global rounds) and GLOBAL_HEM_LP (handshake matching + LP
+    growth) through the full dist pipeline (reference: dist
+    ClusteringAlgorithm, dkaminpar.h:73-78)."""
     from kaminpar_tpu.context import DistClusteringAlgorithm
     from kaminpar_tpu.presets import create_context_by_preset_name
 

@@ -141,3 +141,38 @@ def test_capacity_auction_strict_and_matches_oracle_uncontended():
     acc_s = lp.capacity_auction_sorted(key, movers, target, node_w, base, wide, L)
     assert bool(jnp.all((movers & acc_p) == movers))
     assert bool(jnp.all((movers & acc_s) == movers))
+
+
+def test_auction_radix_equals_bitwise_and_oracle():
+    """The radix-32 threshold auction (r5 on-silicon rewrite) must admit
+    EXACTLY the bitwise bisection's set, which is the maximal
+    random-priority prefix per target (the sorted-oracle semantics)."""
+    from kaminpar_tpu.ops.lp import _auction_bitwise, _auction_radix
+
+    rng = np.random.default_rng(0)
+    n, L = 2048, 24  # fixed shapes: one compile for all trials
+    for trial in range(6):
+        movers = rng.random(n) < 0.6
+        target = rng.integers(0, L, n)
+        node_w = rng.integers(1, 9, n)
+        base = rng.integers(0, 40, L)
+        maxw = rng.integers(10, 80, L)
+        # unique priorities: collisions make the oracle order ambiguous
+        prio = rng.choice(1 << 30, size=n, replace=False).astype(np.int32)
+        args = (jnp.asarray(prio), jnp.asarray(movers), jnp.asarray(target),
+                jnp.asarray(node_w), jnp.asarray(base), jnp.asarray(maxw), L)
+        a = np.asarray(_auction_radix(*args))
+        b = np.asarray(_auction_bitwise(*args))
+        assert np.array_equal(a, b), f"trial {trial}"
+        acc = np.zeros(n, bool)
+        for t in range(L):
+            idx = np.flatnonzero(movers & (target == t))
+            idx = idx[np.argsort(prio[idx])]
+            room = maxw[t] - base[t]
+            for u in idx:
+                if node_w[u] <= room:
+                    acc[u] = True
+                    room -= node_w[u]
+                else:
+                    break  # maximal prefix stops at the first non-fit
+        assert np.array_equal(a, acc), f"trial {trial} vs oracle"
