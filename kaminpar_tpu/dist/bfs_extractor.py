@@ -95,8 +95,6 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
     (ExteriorStrategy::{EXCLUDE,CONTRACT}; INCLUDE is EXCLUDE plus the
     boundary ring, which radius+1 already gives).
     """
-    from ..graph.csr import CSRGraph
-
     if exterior not in ("exclude", "contract"):
         raise ValueError(f"unknown exterior strategy {exterior!r}")
     hops = dist_bfs_hops(mesh, dgraph, seeds, radius=radius)
@@ -140,28 +138,16 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
         nw_sub.append(np.maximum(ext_w, 1))  # zero-weight nodes break caps
         part = np.concatenate([part, np.arange(k, dtype=np.int64)])
 
-    es = np.concatenate(e_src)
-    ed = np.concatenate(e_dst)
-    ew = np.concatenate(e_w)
-    if len(es):
-        # merge parallel edges (contracting many boundary edges into one
-        # supernode creates them)
-        pair = es * n_total + ed
-        order = np.argsort(pair, kind="stable")
-        pair_s, es_s, ed_s, ew_s = pair[order], es[order], ed[order], ew[order]
-        first = np.concatenate([[True], pair_s[1:] != pair_s[:-1]])
-        seg = np.cumsum(first) - 1
-        merged_w = np.bincount(seg, weights=ew_s.astype(float)).astype(np.int64)
-        es_m, ed_m = es_s[first], ed_s[first]
-    else:  # edgeless region (radius 0 / isolated seeds)
-        es_m = ed_m = merged_w = np.zeros(0, np.int64)
+    # from_edge_list merges the parallel edges that contracting many
+    # boundary edges into one supernode creates (weights summed), and
+    # handles the edgeless radius-0 region; edges are already symmetric
+    # here and self-loops cannot occur (cu != cv by construction).
+    from ..graph.csr import from_edge_list
 
-    deg = np.bincount(es_m, minlength=n_total)
-    row_ptr = np.concatenate([[0], np.cumsum(deg)])
-    # es_m is sorted by (src, dst) already
-    graph = CSRGraph(
-        row_ptr.astype(np.int64), ed_m.astype(np.int64),
-        np.concatenate(nw_sub), merged_w,
+    edges = np.stack([np.concatenate(e_src), np.concatenate(e_dst)], axis=1)
+    graph = from_edge_list(
+        n_total, edges, edge_weights=np.concatenate(e_w),
+        node_weights=np.concatenate(nw_sub), symmetrize=False,
     )
     return BfsResult(
         graph=graph,

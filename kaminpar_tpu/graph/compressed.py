@@ -76,6 +76,14 @@ class CompressedGraph:
     def decompress(self) -> CSRGraph:
         """Rebuild the CSRGraph (vectorized; the same arithmetic runs under
         jit for on-device decoding)."""
+        row_ptr, col, node_w, edge_w = self.decompress_arrays()
+        return CSRGraph(row_ptr, col, node_w, edge_w)
+
+    def decompress_arrays(self):
+        """Decode to plain numpy (row_ptr, col, node_w, edge_w-or-None) —
+        no CSRGraph wrapper, so no device transfer and no edge_u kernel.
+        The distributed staging path (dist/compressed.py) depends on this
+        staying host-only."""
         deg = self.degree.astype(np.int64)
         row_ptr = np.zeros(self.n + 1, dtype=np.int64)
         np.cumsum(deg, out=row_ptr[1:])
@@ -98,19 +106,21 @@ class CompressedGraph:
         firsts = pos == 0
         base = np.where(firsts, u_arr, 0)
         vals = base + gaps
-        # segmented prefix sum: cumsum with reset at row starts
+        # segmented prefix sum: global cumsum minus the value just before
+        # each row's start.  (An earlier max.accumulate trick silently
+        # required non-negative columns; shard-relative columns in the
+        # distributed compressed graph are signed.)
         c = np.cumsum(vals)
-        seg_base = np.where(firsts, c - vals, 0)
-        run_base = np.maximum.accumulate(seg_base)
-        col = c - run_base
+        c_ext = np.concatenate([np.zeros(1, c.dtype), c])
+        col = c - np.repeat(c_ext[row_ptr[:-1]], deg)
 
         if m >= 2**31:
             raise ValueError("edge count exceeds int32; use the 64-bit path")
-        return CSRGraph(
+        return (
             row_ptr.astype(np.int32),
             col.astype(np.int32),
-            self.node_w,
-            None if self.edge_w is None else self.edge_w,
+            np.asarray(self.node_w),
+            None if self.edge_w is None else np.asarray(self.edge_w),
         )
 
 

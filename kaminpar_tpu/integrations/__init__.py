@@ -1,0 +1,3 @@
+"""Third-party graph-library adapters (reference: bindings/)."""
+
+from .networkit import KaMinParNetworKit  # noqa: F401

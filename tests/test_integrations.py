@@ -1,0 +1,68 @@
+"""NetworKit adapter (reference: bindings/networkit).  NetworKit itself is
+not bundled; a duck-typed stand-in exercises the same protocol surface the
+real networkit.Graph exposes."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.graph.metrics import edge_cut, is_feasible
+from kaminpar_tpu.integrations import KaMinParNetworKit
+from kaminpar_tpu.integrations.networkit import networkit_to_csr
+
+
+class FakeNkGraph:
+    """Duck-typed networkit.Graph over one of our CSR graphs."""
+
+    def __init__(self, g, weighted=False, directed=False):
+        self.rp = np.asarray(g.row_ptr)
+        self.col = np.asarray(g.col_idx)
+        self.w = np.asarray(g.edge_w)
+        self._weighted = weighted
+        self._directed = directed
+
+    def numberOfNodes(self):
+        return len(self.rp) - 1
+
+    def isWeighted(self):
+        return self._weighted
+
+    def isDirected(self):
+        return self._directed
+
+    def iterNeighbors(self, u):
+        yield from self.col[self.rp[u]: self.rp[u + 1]]
+
+    def iterNeighborsWeights(self, u):
+        for e in range(self.rp[u], self.rp[u + 1]):
+            yield self.col[e], float(self.w[e])
+
+
+def test_networkit_roundtrip_and_partition():
+    g = generators.grid2d_graph(16, 16)
+    G = FakeNkGraph(g)
+    csr = networkit_to_csr(G)
+    assert csr.n == g.n and csr.m == g.m
+    assert np.array_equal(np.asarray(csr.col_idx), np.asarray(g.col_idx))
+
+    solver = KaMinParNetworKit(G, ctx="fast")
+    part = solver.compute_partition_k(4)
+    assert isinstance(part, list) and len(part) == g.n
+    part = np.asarray(part)
+    assert is_feasible(g, part, 4, solver.ctx.partition.max_block_weights)
+    assert edge_cut(g, part) < 200  # grid 16x16 into quarters: far below random
+
+
+def test_networkit_weighted_and_factors():
+    g0 = generators.grid2d_graph(8, 8)
+    G = FakeNkGraph(g0, weighted=True)
+    csr = networkit_to_csr(G)
+    assert int(np.asarray(csr.edge_w).sum()) == g0.total_edge_weight
+
+    solver = KaMinParNetworKit(G, ctx="fast")
+    part = solver.compute_partition_with_factors([0.6, 0.6])
+    bw = np.bincount(part, minlength=2)
+    assert bw.max() <= int(np.ceil(0.6 * 64))
+
+    with pytest.raises(ValueError, match="undirected"):
+        networkit_to_csr(FakeNkGraph(g0, directed=True))
