@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""rgg64k eco plateau experiment (VERDICT r5 carry-over of r4 next #3).
+
+Hypothesis on record (BASELINE_measured.md r5): the rgg64k eco mean sits
+at ~1.12 because of per-seed extension variance (spread 1.07-1.14), so
+keep-best repetition over extension — not more FM — is the lever.  Arms:
+
+  base      eco as shipped
+  devext2   eco + batched device extension, keep-best of 2
+  devext3   eco + batched device extension, keep-best of 3
+  nested3   eco + host nested extension with 3 reps (was 2)
+
+3 seeds each, ref cut 120000 (measured r2, bench_data/ref_cache.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, REPO)
+
+from kaminpar_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+REF = 120000.0
+K = 64
+SEEDS = (1, 2, 3)
+
+
+def run_arm(name: str, mutate) -> dict:
+    import numpy as np
+
+    from kaminpar_tpu.graph import metrics
+    from kaminpar_tpu.io import read_metis
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    g = read_metis(os.path.join(REPO, "bench_data", "rgg64k.metis"))
+    cuts, walls = [], []
+    for seed in SEEDS:
+        ctx = create_context_by_preset_name("eco")
+        ctx.seed = seed
+        mutate(ctx)
+        s = KaMinPar(ctx)
+        s.set_graph(g)
+        t = time.perf_counter()
+        part = s.compute_partition(K, epsilon=0.03)
+        walls.append(time.perf_counter() - t)
+        assert metrics.is_feasible(g, part, K, s.ctx.partition.max_block_weights)
+        cuts.append(int(metrics.edge_cut(g, part)))
+    rec = {
+        "arm": name, "cuts": cuts,
+        "mean": float(np.mean(cuts)),
+        "ratio": round(float(np.mean(cuts)) / REF, 4),
+        "ratio_spread": [round(min(cuts) / REF, 4), round(max(cuts) / REF, 4)],
+        "wall_s": [round(w, 1) for w in walls],
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> None:
+    arms = {
+        "base": lambda ctx: None,
+        "devext2": lambda ctx: (
+            setattr(ctx.initial_partitioning, "device_extension", True),
+            setattr(ctx.initial_partitioning, "device_extension_reps", 2),
+        ),
+        "devext3": lambda ctx: (
+            setattr(ctx.initial_partitioning, "device_extension", True),
+            setattr(ctx.initial_partitioning, "device_extension_reps", 3),
+        ),
+        "nested3": lambda ctx: setattr(
+            ctx.initial_partitioning, "nested_extension_reps", 3
+        ),
+    }
+    only = sys.argv[1:] or list(arms)
+    out = [run_arm(name, arms[name]) for name in only]
+    with open(os.path.join(REPO, "bench_data", "rgg_experiment.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
