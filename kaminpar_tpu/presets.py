@@ -75,6 +75,21 @@ def create_eco_context() -> Context:
     return ctx
 
 
+def create_eco_devext_context() -> Context:
+    """eco + batched device extension with keep-best-of-2.  Measured round 5
+    (bench_data/rgg_experiment.json, seeds {1,2,3}): on rgg64k k=64 this
+    takes the eco ratio 1.098 -> 1.036 with the seed spread collapsing from
+    [1.066, 1.146] to [1.012, 1.052], at ~2x faster extension — extension
+    variance was the plateau (BASELINE_measured.md).  Not folded into plain
+    eco: grid256's host-path eco currently beats the reference (0.957) and
+    the device path measured slightly worse there (DIVERGENCES #6)."""
+    ctx = create_eco_context()
+    ctx.preset_name = "eco-devext"
+    ctx.initial_partitioning.device_extension = True
+    ctx.initial_partitioning.device_extension_reps = 2
+    return ctx
+
+
 def create_strong_context() -> Context:
     """Reference: ``create_*_strong_context`` (presets.cc:479-484): the eco
     chain plus two-way flow refinement.  Flow is replaced by JET (documented
@@ -263,6 +278,7 @@ _PRESETS = {
     "strong": create_strong_context,
     "flow": create_strong_context,  # reference alias (presets.cc:26)
     "eco": create_eco_context,
+    "eco-devext": create_eco_devext_context,
     "fm": create_eco_context,  # reference alias (presets.cc:24)
     "jet": create_jet_context,
     "4xjet": lambda: create_jet_context(4),
