@@ -85,8 +85,12 @@ def run_lp_phase() -> dict:
     from kaminpar_tpu.coarsening.max_cluster_weights import compute_max_cluster_weight
     from kaminpar_tpu.context import Context
     from kaminpar_tpu.graph.generators import rmat_graph
-    from kaminpar_tpu.ops import lp
+    from kaminpar_tpu.ops import lp, pallas_lp
     from kaminpar_tpu.utils import RandomState, next_key
+    from kaminpar_tpu.utils import compile_stats
+
+    compile_stats.enable_compile_time_tracking()
+    compile_stats.reset()
 
     dev = jax.devices()[0]
     backend = dev.platform
@@ -97,6 +101,12 @@ def run_lp_phase() -> dict:
     scale = int(os.environ.get("KPTPU_BENCH_SCALE", default_scale))
     rounds = int(os.environ.get("KPTPU_BENCH_ROUNDS", 5))
     k = int(os.environ.get("KPTPU_BENCH_K", 16))
+    # LP round kernel backend for the microbench: "xla" | "pallas" | "auto".
+    # The prober measures both so every TPU window yields an A/B number.
+    lp_kernel = pallas_lp.resolve_lp_kernel(
+        os.environ.get("KPTPU_BENCH_LP_KERNEL", "xla")
+    )
+    round_mod = pallas_lp if lp_kernel == "pallas" else lp
 
     RandomState.reseed(0)
     graph = rmat_graph(scale, edge_factor=16, seed=1)
@@ -116,7 +126,7 @@ def run_lp_phase() -> dict:
     max_w = jnp.asarray(max_cw, dtype=idt)
 
     def one_round(state):
-        return lp.lp_round_bucketed(
+        return round_mod.lp_round_bucketed(
             state, next_key(), bv.buckets, bv.heavy, bv.gather_idx, pv.node_w,
             max_w, num_labels=n_pad,
         )
@@ -152,6 +162,8 @@ def run_lp_phase() -> dict:
         "device_kind": str(device_kind),
         "baseline": BASELINE_PROVENANCE,
         "est_hbm_gbps_lb": round(est_gbps, 1),
+        "lp_kernel": lp_kernel,
+        "lp_compile": compile_stats.compile_time_snapshot(),
     }
     if hbm_peak:
         record["hbm_frac_of_peak_lb"] = round(est_gbps / hbm_peak, 4)
@@ -174,6 +186,11 @@ def run_full_phase(record: dict | None = None) -> dict:
     from kaminpar_tpu.kaminpar import KaMinPar
     from kaminpar_tpu.utils import RandomState
 
+    from kaminpar_tpu.utils import compile_stats
+
+    compile_stats.enable_compile_time_tracking()
+    compile_stats.reset()
+
     record = dict(record or {})
     backend = jax.devices()[0].platform
     on_accel = backend != "cpu"
@@ -191,6 +208,11 @@ def run_full_phase(record: dict | None = None) -> dict:
     part = shm.compute_partition(k, epsilon=0.03)
     wall = time.perf_counter() - t0
     cut = int(edge_cut(fgraph, part))
+    # Distinct kernel specializations + actual compile wall-time of the
+    # full-partition phase — the cold-compile tax the geometric shape
+    # buckets bound (ISSUE 1; one ~35-48 s compile per shape on a tunneled
+    # TPU, TPU_NOTES.md).
+    shape_counts = compile_stats.snapshot()
     record.update({
         "backend": record.get("backend", backend),
         "partition_wall_s": round(wall, 2),
@@ -198,6 +220,8 @@ def run_full_phase(record: dict | None = None) -> dict:
         "partition_scale": full_scale,
         "partition_k": k,
         "partition_edges_per_sec": round(fgraph.m / wall, 1),
+        "compiled_shape_count": shape_counts,
+        "partition_compile": compile_stats.compile_time_snapshot(),
     })
     print(json.dumps(record), flush=True)
     return record
@@ -359,7 +383,8 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
         })
         if full_rec and "partition_wall_s" in full_rec:
             for key in ("partition_wall_s", "partition_cut", "partition_scale",
-                        "partition_k", "partition_edges_per_sec"):
+                        "partition_k", "partition_edges_per_sec",
+                        "compiled_shape_count", "partition_compile"):
                 if key in full_rec:
                     rec[key] = full_rec[key]
         else:

@@ -11,6 +11,44 @@ import os as _os
 
 import jax as _jax
 
+# Compatibility shims: the codebase targets the modern jax API surface
+# (jax.shard_map, jax.lax.axis_size, jax.enable_x64); on older jax (< 0.6,
+# e.g. the baked 0.4.x toolchain) those live elsewhere or need flags.
+# Alias them so the distributed tier works on both.
+if not hasattr(_jax, "shard_map"):  # pragma: no cover - version dependent
+    try:
+        from functools import wraps as _wraps
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @_wraps(_shard_map)
+        def _shard_map_compat(f, *args, **kwargs):
+            # 0.4.x shard_map lacks replication rules for while/scan; the
+            # modern entry point tolerates them, so default the check off
+            # (this is jax's own documented workaround).
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, *args, **kwargs)
+
+        _jax.shard_map = _shard_map_compat
+    except Exception:
+        pass
+
+if not hasattr(_jax.lax, "axis_size"):  # pragma: no cover - version dependent
+    def _axis_size(axis_name):
+        frame = _jax.core.axis_frame(axis_name)
+        # 0.4.x axis_frame returns the size itself; later returns a frame.
+        return getattr(frame, "size", frame)
+
+    _jax.lax.axis_size = _axis_size
+
+if not hasattr(_jax, "enable_x64"):  # pragma: no cover - version dependent
+    try:
+        from jax.experimental import enable_x64 as _enable_x64
+
+        _jax.enable_x64 = _enable_x64
+    except Exception:
+        pass
+
 # Persistent XLA compilation cache: multilevel runs hit a bounded set of
 # power-of-2 kernel shapes (see graph/csr.py PaddedView); caching them on disk
 # makes every run after the first start hot (measured 6.4x on a full CPU

@@ -284,7 +284,10 @@ int64_t kptpu_compute_partition(kptpu_solver_t *solver, uint32_t k,
   }
   GilGuard gil;
   PyObject *n_obj = PyObject_GetAttrString(solver->handle, "n");
-  long n = n_obj ? PyLong_AsLong(n_obj) : -1;
+  /* 64-bit local via PyLong_AsLongLong: a C long is 32-bit on LLP64
+   * platforms (Windows), which would overflow for n >= 2^31 even though n
+   * itself is declared uint32 on the API surface. */
+  long long n = n_obj ? PyLong_AsLongLong(n_obj) : -1;
   Py_XDECREF(n_obj);
   if (n <= 0) {
     capture_py_error("no graph set");

@@ -69,6 +69,14 @@ class LPClustering:
                 "kernels; falling back to uniform tie-breaking"
             )
 
+    def _iterate_fn(self):
+        """LP sweep-loop implementation per the lp_kernel backend switch
+        (ops/pallas_lp.py: fused Pallas kernels, bit-identical off-TPU via
+        interpret mode)."""
+        from ..ops.pallas_lp import select_lp_ops
+
+        return select_lp_ops(self.ctx.lp_kernel)[0]
+
     def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
         """Returns padded labels (over graph.padded()); pad nodes carry the
         anchor label."""
@@ -122,7 +130,8 @@ class LPClustering:
         ):
             # see LabelPropagationContext.low_degree_boost_threshold
             iters *= max(self.ctx.low_degree_boost_factor, 1)
-        state = lp.lp_iterate_bucketed(
+        iterate = self._iterate_fn()
+        state = iterate(
             state,
             next_key(),
             bv.buckets,

@@ -99,6 +99,15 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
         raise ValueError(f"unknown exterior strategy {exterior!r}")
     hops = dist_bfs_hops(mesh, dgraph, seeds, radius=radius)
     labels_host = np.asarray(labels)[: dgraph.n].astype(np.int64)
+    # An out-of-range label would make the np.bincount below return more
+    # than k supernode weights, desynchronizing the weight vector from the
+    # partition array and only failing much later inside from_edge_list.
+    if labels_host.size:
+        lo, hi = int(labels_host.min()), int(labels_host.max())
+        if lo < 0 or hi >= k:
+            raise ValueError(
+                f"partition labels must lie in [0, {k}); got range [{lo}, {hi}]"
+            )
     node_w = np.asarray(dgraph.node_w)[: dgraph.n].astype(np.int64)
 
     reached = hops < _INF

@@ -91,6 +91,21 @@ class DistGraph(NamedTuple):
             slots = cl[s][real].astype(np.int64)
             gg = self.ghost_global[s]
             is_local = slots < self.n_loc
+            # Layout invariant: every non-local slot must resolve to a ghost
+            # entry.  Fail fast instead of silently clipping to the last
+            # ghost (or global node 0), which would corrupt edges.
+            nonlocal_slots = slots[~is_local]
+            if len(gg) == 0:
+                if nonlocal_slots.size:
+                    raise ValueError(
+                        f"shard {s}: {nonlocal_slots.size} non-local edge "
+                        "slots but the shard has no ghost entries"
+                    )
+            elif nonlocal_slots.size and int(nonlocal_slots.max()) - self.n_loc >= len(gg):
+                raise ValueError(
+                    f"shard {s}: ghost slot {int(nonlocal_slots.max())} out of "
+                    f"range (n_loc={self.n_loc}, ghosts={len(gg)})"
+                )
             dst = np.where(
                 is_local,
                 slots + s * self.n_loc,

@@ -25,12 +25,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
-from ..utils.intmath import next_pow2_strict
+from ..utils.intmath import next_shape_bucket
 
 
 def _next_bucket(x: int, minimum: int = 256) -> int:
-    """Next power-of-2 shape bucket (strictly > x, reserving pad slots)."""
-    return next_pow2_strict(x, minimum)
+    """Next geometric shape bucket (strictly > x, reserving pad slots).
+
+    Powers of sqrt(2) on n and m (utils/intmath.next_shape_bucket): every
+    multilevel level — including the coarse graphs the cluster coarsener
+    produces — pads onto this ladder, so a full v-cycle touches O(log n)
+    distinct padded shapes while wasting at most ~41% slots per level
+    (pure powers of two waste up to ~100%)."""
+    return next_shape_bucket(x, minimum)
 
 
 class PaddedView(NamedTuple):
@@ -134,6 +140,11 @@ class CSRGraph:
             node_w = jnp.concatenate([self.node_w, jnp.zeros(n_fill, dtype=idt)])
             edge_w = jnp.concatenate([self.edge_w, jnp.zeros(m_fill, dtype=idt)])
             edge_u = _compute_edge_u(row_ptr, m_pad)
+            from ..utils import compile_stats
+
+            # Census of (n_pad, m_pad) shape buckets actually materialized —
+            # the quantity the geometric ladder bounds to O(log n) per run.
+            compile_stats.record("padded_bucket", statics=(n_pad, m_pad))
             self._padded = PaddedView(
                 row_ptr, col_idx, node_w, edge_w, edge_u, self.n, self.m
             )

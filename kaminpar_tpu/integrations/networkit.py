@@ -93,7 +93,10 @@ class KaMinParNetworKit(KaMinPar):
     ) -> list:
         return self.compute_partition(
             len(max_block_weights), max_block_weights=list(max_block_weights),
+            # `is not None`, not truthiness: an empty min list must reach the
+            # downstream k/length validation as a mismatch, not silently
+            # drop the constraint (ADVICE r5 #5).
             min_block_weights=(
-                list(min_block_weights) if min_block_weights else None
+                list(min_block_weights) if min_block_weights is not None else None
             ),
         ).tolist()

@@ -38,6 +38,11 @@ class KaMinPar:
         elif isinstance(ctx, str):
             ctx = create_context_by_preset_name(ctx)
         self.ctx = ctx
+        # Persistent compilation cache per the context's parallel settings
+        # (the env-var defaults applied at package import are the fallback).
+        from .context import configure_compilation_cache
+
+        configure_compilation_cache(ctx.parallel)
         self.graph: Optional[CSRGraph] = None
         self.compressed_graph: Optional[object] = None
         self._last: Optional[PartitionedGraph] = None
@@ -189,7 +194,12 @@ class KaMinPar:
         )
         ctx.partition.setup(total_node_weight, k, epsilon, min_epsilon)
         if max_block_weights is not None:
-            ctx.partition.max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
+            max_bw = np.asarray(max_block_weights, dtype=np.int64)
+            if max_bw.shape != (k,):
+                raise ValueError(
+                    f"max_block_weights must have length k={k}, got {max_bw.shape}"
+                )
+            ctx.partition.max_block_weights = max_bw
         else:
             # strictness adjustment for weighted nodes (kaminpar.cc setup)
             perfect = (total_node_weight + k - 1) // k
@@ -197,7 +207,14 @@ class KaMinPar:
                 ctx.partition.max_block_weights, perfect + max_node_weight
             )
         if min_block_weights is not None:
-            ctx.partition.min_block_weights = np.asarray(min_block_weights, dtype=np.int64)
+            min_bw = np.asarray(min_block_weights, dtype=np.int64)
+            # An empty or mismatched list is a caller error, not "no
+            # constraint" (ADVICE r5 #5).
+            if min_bw.shape != (k,):
+                raise ValueError(
+                    f"min_block_weights must have length k={k}, got {min_bw.shape}"
+                )
+            ctx.partition.min_block_weights = min_bw
 
         if src.n == 0:
             from .graph.csr import from_numpy_csr

@@ -244,6 +244,13 @@ def bucketed_best_moves(
             )
         )
 
+    return assemble_moves(outs, gather_idx, labels, n, n_pad)
+
+
+def assemble_moves(outs, gather_idx, labels, n: int, n_pad: int):
+    """Gather per-bucket row results into (n_pad,) node arrays with inert
+    defaults on pad nodes.  Shared by the XLA path above and the fused
+    Pallas path (ops/pallas_lp.py), which must assemble identically."""
     target = jnp.concatenate([o[0] for o in outs])[gather_idx]
     tconn = jnp.concatenate([o[1] for o in outs])[gather_idx]
     own_conn = jnp.concatenate([o[2] for o in outs])[gather_idx]

@@ -28,6 +28,9 @@ from .segment import run_ids, run_starts2
 
 @jax.jit
 def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
+    from ..utils import compile_stats
+
+    compile_stats.record("contraction", arrays=[labels, col_idx])
     n = labels.shape[0]
     m = col_idx.shape[0]
     idt = labels.dtype

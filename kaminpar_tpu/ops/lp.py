@@ -50,6 +50,23 @@ class LPState(NamedTuple):
     num_moved: jax.Array  # () int32 — nodes moved in the last round
 
 
+def num_labels_bucket(k: int, floor: int = 64) -> int:
+    """Label-space shape bucket for refinement-mode LP (num_labels = k).
+
+    Every deep/v-cycle run refines at the whole k ladder (2, 4, ..., k) on
+    every level, and num_labels is a *shape* (the label-weight tables), so
+    each intermediate k used to compile its own kernel.  Padding the label
+    space to a floor bucket (empty labels carry weight 0 and a 0 max-weight,
+    are adjacent to nothing, and thus are inert in ratings, the auction, and
+    the commit) collapses the ladder onto one compiled shape per graph.
+    The per-round results are bit-identical to the unpadded instantiation:
+    no random draw's shape depends on num_labels, and the auction resolves
+    thresholds per label independently."""
+    from ..utils.intmath import next_pow2
+
+    return max(floor, next_pow2(k))
+
+
 @partial(jax.jit, static_argnames=("num_labels",))
 def init_state(labels, node_w, num_labels: int) -> LPState:
     label_weights = jax.ops.segment_sum(node_w, labels, num_segments=num_labels)
@@ -87,6 +104,21 @@ def capacity_auction_sorted(key, movers, target, node_w, base_weights, max_weigh
 _RADIX_BITS = 5
 _RADIX = 1 << _RADIX_BITS
 _PRIO_BITS = 30  # 6 radix-32 levels resolve the threshold exactly
+# Budget for the (num_labels, 32) per-level radix histogram transient.  The
+# histogram is accumulated in the *promoted weight dtype*, so the label
+# cutoff must scale with its itemsize: the old fixed 2^22-label gate meant a
+# ~1 GB transient in 64-bit-weight builds (ADVICE r5 #3).  512 MB keeps the
+# int32 cutoff at the measured 2^22 boundary and halves it for int64.
+_RADIX_HIST_BYTE_LIMIT = 1 << 29
+
+
+def use_radix_auction(num_labels: int, weight_dtype) -> bool:
+    """Whether the radix-32 auction's histogram fits the transient budget
+    (else the 30-pass bitwise bisection is the safer trade).  Shared by the
+    XLA auction below and the fused Pallas commit kernel (ops/pallas_lp.py)
+    so both paths stay bit-identical."""
+    itemsize = jnp.dtype(weight_dtype).itemsize
+    return num_labels * _RADIX * itemsize <= _RADIX_HIST_BYTE_LIMIT
 
 
 def capacity_auction(
@@ -132,9 +164,13 @@ def capacity_auction(
     )
     # Radix needs a (num_labels * 32) histogram per level — fine for
     # refinement (num_labels = k) and mid-size clustering, but at
-    # num_labels = n ~ 2^24 that is a multi-GB transient.  Past 2^22
-    # (<= 512 MB int32) the 31-pass bitwise form is the safer trade.
-    if num_labels > (1 << 22):
+    # num_labels = n ~ 2^24 that is a multi-GB transient.  The cutoff is a
+    # byte budget on the histogram (accumulated in the promoted weight
+    # dtype), so 64-bit-weight builds switch to the bitwise form earlier.
+    wdt = jnp.promote_types(
+        jnp.asarray(node_w).dtype, jnp.asarray(base_weights).dtype
+    )
+    if not use_radix_auction(num_labels, wdt):
         return _auction_bitwise(
             prio, movers, target, node_w, base_weights, max_weights, num_labels
         )
@@ -401,6 +437,18 @@ def lp_iterate_bucketed(
     traced scalar (like ``min_moved``): it only feeds the while-loop cond, and
     keeping it dynamic means one compile per shape bucket even when the
     low-degree boost varies the sweep budget across levels."""
+    from ..utils import compile_stats
+
+    # Trace-time record: fires once per XLA specialization of this kernel
+    # (the compile the padding policy tries to minimize), never per round.
+    compile_stats.record(
+        "lp_iterate",
+        arrays=[node_w, *(b.cols for b in buckets), heavy.cols],
+        statics=(
+            "xla", num_labels, active_prob, allow_tie_moves, tie_break,
+            jnp.asarray(max_label_weights).ndim,
+        ),
+    )
     max_iterations = jnp.asarray(max_iterations, dtype=jnp.int32)
 
     def cond(carry):

@@ -214,7 +214,44 @@ class DeepMultilevelPartitioner:
         if bad.any():
             part = np.where(bad, np.asarray(pre_part), part)
             p_graph = p_graph.with_partition(part)
+            if not p_graph.is_feasible():
+                # Reverting cross-community moves can push blocks back over
+                # their budget, and the restricted refiners that follow can
+                # never repair it (they see the same masked move space that
+                # produced it).  Repair here with group-restricted balance
+                # rounds on the community-masked graph, the device-extension
+                # pattern (partitioning/extension.py:_restricted_refine).
+                p_graph = self._rebalance_restricted(p_graph, comm, blk_comm)
         return p_graph
+
+    def _rebalance_restricted(self, p_graph, comm, blk_comm):
+        import jax.numpy as jnp
+
+        from ..refinement.balancer import _balance_round
+        from ..utils import next_key
+
+        graph = p_graph.graph
+        masked_ew = jnp.where(
+            jnp.asarray(comm)[graph.edge_u] == jnp.asarray(comm)[graph.col_idx],
+            graph.edge_w, 0,
+        )
+        mg = CSRGraph(
+            graph.row_ptr, graph.col_idx, graph.node_w, masked_ew,
+            sorted_by_degree=graph.sorted_by_degree, edge_u=graph.edge_u,
+        )
+        pv = mg.padded()
+        bv = mg.bucketed()
+        max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        labels = pv.pad_node_array(p_graph.partition, 0)
+        for _ in range(self.ctx.refinement.balancer.max_num_rounds):
+            labels, num_moved, still = _balance_round(
+                next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
+                pv.node_w, max_bw, k=p_graph.k,
+                group_of=jnp.asarray(blk_comm, dtype=jnp.int32),
+            )
+            if not bool(still) or int(num_moved) == 0:
+                break
+        return p_graph.with_partition(labels[: pv.n])
 
     def _refine(self, graph: CSRGraph, part, cur_k: int, coarse: bool) -> PartitionedGraph:
         max_bw = intermediate_block_weights(

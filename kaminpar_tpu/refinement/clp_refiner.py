@@ -39,7 +39,14 @@ class CLPRefiner(Refiner):
         pv = p_graph.graph.padded()
         bv = p_graph.graph.bucketed()
         k = p_graph.k
+        # Label-space shape bucket (see lp.num_labels_bucket): inert pad
+        # labels collapse the extension k ladder onto one compiled shape.
+        k_pad = lp.num_labels_bucket(k)
         max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        if k_pad > k:
+            max_w = jnp.concatenate(
+                [max_w, jnp.zeros(k_pad - k, dtype=max_w.dtype)]
+            )
         part = pv.pad_node_array(p_graph.partition, 0)
 
         with scoped_timer("clp_refinement"):
@@ -47,14 +54,17 @@ class CLPRefiner(Refiner):
             colors = color_graph(next_key(), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
             nc = num_colors(colors, mask)
 
-            state = lp.init_state(part, pv.node_w, k)
+            from ..ops.pallas_lp import select_lp_ops
+
+            round_colored = select_lp_ops(self.ctx.lp_kernel)[1]
+            state = lp.init_state(part, pv.node_w, k_pad)
             before = p_graph.edge_cut()
             for it in range(self.ctx.num_iterations):
                 moved = 0
                 for c in range(nc):
-                    state = lp.lp_round_colored(
+                    state = round_colored(
                         state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
-                        pv.node_w, max_w, colors == c, num_labels=k,
+                        pv.node_w, max_w, colors == c, num_labels=k_pad,
                         allow_tie_moves=self.ctx.allow_tie_moves,
                     )
                     moved += int(state.num_moved)

@@ -27,12 +27,23 @@ class LPRefiner(Refiner):
         pv = p_graph.graph.padded()
         bv = p_graph.graph.bucketed()
         k = p_graph.k
+        # Label-space shape bucket: all intermediate k of the extension
+        # ladder share one compiled kernel per graph (pad labels are inert;
+        # see lp.num_labels_bucket).
+        k_pad = lp.num_labels_bucket(k)
         part = pv.pad_node_array(p_graph.partition, 0)  # pads are inert (w=0)
-        state = lp.init_state(part, pv.node_w, k)
+        state = lp.init_state(part, pv.node_w, k_pad)
         max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        if k_pad > k:
+            max_w = jnp.concatenate(
+                [max_w, jnp.zeros(k_pad - k, dtype=max_w.dtype)]
+            )
 
+        from ..ops.pallas_lp import select_lp_ops
+
+        iterate = select_lp_ops(self.ctx.lp_kernel)[0]
         with scoped_timer("lp_refinement"):
-            state = lp.lp_iterate_bucketed(
+            state = iterate(
                 state,
                 next_key(),
                 bv.buckets,
@@ -42,7 +53,7 @@ class LPRefiner(Refiner):
                 max_w,
                 jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
                 jnp.int32(self.ctx.num_iterations),
-                num_labels=k,
+                num_labels=k_pad,
                 active_prob=self.ctx.active_prob,
                 allow_tie_moves=self.ctx.allow_tie_moves,
             )

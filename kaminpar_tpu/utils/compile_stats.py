@@ -1,0 +1,97 @@
+"""Distinct-compiled-shape accounting for the hot kernels.
+
+On-silicon profiling (TPU_NOTES.md r5) showed the full-partition wall-clock
+dominated not by LP compute but by dozens of per-shape cold compiles
+(~35-48 s each through the tunnel).  This module makes the shape count a
+first-class, regression-testable metric: the jitted LP iterate / contraction
+entry points call :func:`record` *inside* their traced bodies, so a record
+fires exactly once per (shape, static-arg) specialization — i.e. once per
+XLA compile of that kernel family per process (the persistent cache may make
+the compile warm, but the specialization count is what the padding policy
+controls and what a cold environment pays for).
+
+``bench.py`` embeds :func:`snapshot` in its headline JSON
+(``compiled_shape_count``), and tests/test_pallas_lp.py asserts the v-cycle
+bound.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_lock = threading.Lock()
+_shapes: dict = defaultdict(set)
+_compile_secs = {"backend_compile_s": 0.0, "trace_s": 0.0, "compile_events": 0}
+_listener_installed = False
+
+
+def _sig_of(arrays, statics) -> tuple:
+    sig = []
+    for a in arrays:
+        if hasattr(a, "shape"):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(repr(a))
+    return tuple(sig), tuple(statics)
+
+
+def record(kind: str, arrays=(), statics=()) -> None:
+    """Record one kernel specialization.  Call from *inside* a jitted body:
+    Python there runs once per compile, never per execution."""
+    sig = _sig_of(arrays, statics)
+    with _lock:
+        _shapes[kind].add(sig)
+
+
+def distinct(kind: str | None = None) -> int:
+    with _lock:
+        if kind is not None:
+            return len(_shapes.get(kind, ()))
+        return sum(len(v) for v in _shapes.values())
+
+
+def snapshot() -> dict:
+    """{kind: distinct specialization count} plus a total."""
+    with _lock:
+        out = {k: len(v) for k, v in sorted(_shapes.items())}
+    out["total"] = sum(out.values())
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _shapes.clear()
+        _compile_secs.update(
+            {"backend_compile_s": 0.0, "trace_s": 0.0, "compile_events": 0}
+        )
+
+
+def enable_compile_time_tracking() -> None:
+    """Accumulate actual XLA compile wall-time via jax.monitoring (the
+    '/jax/core/compile/*' duration events).  Idempotent; bench.py turns this
+    on to report per-phase compile cost next to the shape counts."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring as monitoring
+
+    def _cb(event, duration, **kwargs):
+        with _lock:
+            if event.endswith("backend_compile_duration"):
+                _compile_secs["backend_compile_s"] += duration
+                _compile_secs["compile_events"] += 1
+            elif event.endswith("jaxpr_trace_duration"):
+                _compile_secs["trace_s"] += duration
+
+    monitoring.register_event_duration_secs_listener(_cb)
+    _listener_installed = True
+
+
+def compile_time_snapshot() -> dict:
+    with _lock:
+        return {
+            "backend_compile_s": round(_compile_secs["backend_compile_s"], 2),
+            "trace_s": round(_compile_secs["trace_s"], 2),
+            "compile_events": _compile_secs["compile_events"],
+        }

@@ -12,3 +12,28 @@ def next_pow2_strict(x: int, minimum: int = 1) -> int:
     """Smallest power of two strictly > x (used for pad buckets that must
     reserve at least one pad slot, e.g. the anchor node)."""
     return max(minimum, 1 << int(x).bit_length())
+
+
+# ceil(sqrt(2) * 2^15) — integer sqrt(2) multiplier for the shape ladder.
+_SQRT2_Q15 = 46341
+# Mid rungs align up to 128 lanes so padded sizes stay TPU-tile friendly.
+_BUCKET_ALIGN = 128
+
+
+def next_shape_bucket(x: int, minimum: int = 1) -> int:
+    """Smallest geometric shape bucket strictly > x.
+
+    The ladder is powers of sqrt(2) — {2^k} plus a mid rung
+    ceil(2^k * sqrt(2)) aligned up to 128 — so padded operands cost at most
+    ~41% slack instead of the ~100% worst case of pure powers of two, while
+    a multilevel hierarchy still compiles only O(log n) distinct kernel
+    shapes (two rungs per octave).  Strictly greater than ``x`` so callers
+    can reserve pad slots (the anchor node).
+    """
+    x = int(max(x, 0))
+    p = 1 << x.bit_length()  # smallest power of two strictly > x
+    half = p >> 1
+    mid = (half * _SQRT2_Q15 + (1 << 15) - 1) >> 15
+    mid = -(-mid // _BUCKET_ALIGN) * _BUCKET_ALIGN
+    cand = mid if x < mid < p else p
+    return max(minimum, cand)

@@ -83,9 +83,21 @@ def child_attempt() -> None:
     os.environ.setdefault("KPTPU_BENCH_SCALE", "20")
     os.environ.setdefault("KPTPU_BENCH_FULL", "1")
     os.environ.setdefault("KPTPU_BENCH_FULL_SCALE", "18")
-    from bench import run_benchmark
+    from bench import run_benchmark, run_lp_phase
 
     run_benchmark()
+    # Same-window Pallas A/B (ISSUE 1): re-measure the LP microbench on the
+    # fused-kernel path so the round gets an on-silicon xla-vs-pallas
+    # number.  A Pallas lowering failure must not void the XLA measurement
+    # already flushed above.
+    os.environ["KPTPU_BENCH_LP_KERNEL"] = "pallas"
+    try:
+        run_lp_phase()
+    except Exception as exc:  # noqa: BLE001
+        print(json.dumps({
+            "probe": "pallas_lp_error",
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }), flush=True)
 
 
 def _salvage_lines(out: str) -> list[dict]:
@@ -170,7 +182,17 @@ def run_attempt(attempt: int) -> dict | None:
         "probe": probe,
     })
     if measures and outcome == "measured":
-        best = measures[-1]
+        # Headline = the XLA-path record; a same-window Pallas LP record is
+        # attached as the A/B datum rather than replacing the headline.
+        pallas = [r for r in measures if r.get("lp_kernel") == "pallas"]
+        main = [r for r in measures if r.get("lp_kernel") != "pallas"]
+        best = (main or measures)[-1]
+        if pallas:
+            best["pallas_lp"] = {
+                key: pallas[-1].get(key)
+                for key in ("value", "unit", "vs_baseline", "lp_compile")
+                if key in pallas[-1]
+            }
         best["probe_attempt"] = attempt
         best["probe_init_s"] = (probe or {}).get("init_s")
         return best

@@ -124,14 +124,18 @@ def test_lp_round_bucketed_matches_flat_cut_quality(rng):
 
     state_f = lp.init_state(labels, pv.node_w, n_pad)
     state_b = lp.init_state(labels, pv.node_w, n_pad)
+    # active_prob < 1: the documented oscillation guard for symmetric grids
+    # (ops/lp.py:_commit_moves) — with full activation, strict-improvement
+    # synchronous LP barely merges on a grid and the internal-edge counts
+    # below are single-digit tie-draw noise rather than a quality signal.
     for _ in range(5):
         state_f = lp.lp_round(
             state_f, next_key(), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
-            max_w, num_labels=n_pad,
+            max_w, num_labels=n_pad, active_prob=0.5,
         )
         state_b = lp.lp_round_bucketed(
             state_b, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
-            pv.node_w, max_w, num_labels=n_pad,
+            pv.node_w, max_w, num_labels=n_pad, active_prob=0.5,
         )
 
     def quality(state):

@@ -93,6 +93,7 @@ def test_balancer_noop_on_feasible():
     (lambda: generators.grid2d_graph(24, 24), 4),
     (lambda: generators.rmat_graph(10, 8, seed=9), 8),
 ])
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dkaminpar_endtoend_strictly_feasible(gen, k):
     """End-to-end dist pipeline now guarantees eps=0.03 feasibility
     (VERDICT r1 next-step #4 done-criterion)."""

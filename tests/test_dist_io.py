@@ -1,14 +1,26 @@
 """Chunked per-shard IO tests (reference: dist_metis_parser.cc)."""
 
+import os
+
 import numpy as np
+import pytest
 
 from kaminpar_tpu.io.dist_io import read_metis_chunked, read_metis_sharded
 from kaminpar_tpu.io.metis import read_metis, write_metis
 
+# The large-file fixture ships with the reference checkout, which is not
+# present in every container; the chunked-reader logic itself is still
+# covered below by the roundtrip tests on generated graphs.
+_RGG = "/root/reference/misc/rgg2d.metis"
+needs_reference_graph = pytest.mark.skipif(
+    not os.path.exists(_RGG), reason="reference rgg2d.metis not available"
+)
 
+
+@needs_reference_graph
 def test_chunked_matches_full_read():
-    full = read_metis("/root/reference/misc/rgg2d.metis")
-    assembled = read_metis_sharded("/root/reference/misc/rgg2d.metis", 8)
+    full = read_metis(_RGG)
+    assembled = read_metis_sharded(_RGG, 8)
     np.testing.assert_array_equal(
         np.asarray(full.row_ptr), np.asarray(assembled.row_ptr)
     )
@@ -20,8 +32,9 @@ def test_chunked_matches_full_read():
     )
 
 
+@needs_reference_graph
 def test_chunked_ranges_partition_nodes():
-    chunks = list(read_metis_chunked("/root/reference/misc/rgg2d.metis", 5))
+    chunks = list(read_metis_chunked(_RGG, 5))
     assert len(chunks) == 5
     covered = []
     for s, (lo, hi), ch in chunks:

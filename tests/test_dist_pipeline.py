@@ -111,6 +111,7 @@ def test_project_partition_up():
     (lambda: generators.grid2d_graph(24, 24), 4),
     (lambda: generators.rmat_graph(10, 8, seed=9), 8),
 ])
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dkaminpar_endtoend(gen, k):
     mesh = _mesh()
     g = gen()
@@ -127,6 +128,7 @@ def test_dkaminpar_endtoend(gen, k):
     assert metrics.edge_cut(g, part) < rand_cut
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dkaminpar_cli_entry(tmp_path):
     """dKaMinPar binary analog (apps/dKaMinPar.cc:546): parse, mesh, read,
     partition, write."""
@@ -146,6 +148,7 @@ def test_dkaminpar_cli_entry(tmp_path):
     assert set(np.unique(part)) <= set(range(4))
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_kway_scheme():
     """dist k-way scheme (reference: kway_multilevel.cc): coarsen to C*k,
     direct k-way IP on the replicated coarsest, refine up — no extension."""
@@ -171,6 +174,7 @@ def test_dist_kway_scheme():
 
 @pytest.mark.parametrize("algo", ["local-lp", "local-global-lp",
                                   "global-hem-lp"])
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_alternative_clusterers_pipeline(algo):
     """LOCAL_LP (pure shard-local clustering -> exchange-free local
     contraction, local_contraction.cc role), LOCAL_GLOBAL_LP (LOCAL_LP
@@ -195,6 +199,7 @@ def test_dist_alternative_clusterers_pipeline(algo):
     assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_sharded_extension_pipeline():
     """Sharded extension path (dist/extension.py): the full dist pipeline
     with device_extension engaged at test sizes — no per-level full
@@ -221,6 +226,7 @@ def test_dist_sharded_extension_pipeline():
     assert metrics.edge_cut(g, part) < metrics.edge_cut(g, rng.integers(0, k, g.n))
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_mesh_split_replica_refinement():
     """Mesh splitting (deep_multilevel.cc:80-96): R=2 replica groups refine
     two candidates concurrently on disjoint sub-meshes; the returned winner
@@ -253,6 +259,7 @@ def test_mesh_split_replica_refinement():
     assert int(cuts.min()) < metrics.edge_cut(g, parts_R[0])
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_nontoy_rmat14_full_partition():
     """Non-toy dist e2e (VERDICT r4 next-steps #6): RMAT scale-14 on the
     8-device mesh — (a) cut within a factor of the shm pipeline's, (b) the
@@ -322,6 +329,7 @@ def test_dist_nontoy_rmat14_full_partition():
     assert ok, problems
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_deep_extends_partition():
     """VERDICT r1 #7 done-criterion: dist deep must produce k > k0 through
     extension during uncoarsening (reference: dist deep_multilevel.cc
@@ -377,6 +385,7 @@ def test_dist_metrics_match_host():
     )
 
 
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_pipeline_int64():
     """64-bit dist mode end-to-end (reference: KAMINPAR_64BIT_* switches;
     VERDICT r1 minor: dist tier previously hardcoded int32)."""
@@ -433,6 +442,7 @@ def test_dist_validate_partition():
 
 
 @pytest.mark.parametrize("strategy", ["best-moves", "local-moves"])
+@pytest.mark.slow  # full dist pipeline on the virtual mesh: tier-2 (pytest -m slow)
 def test_dist_pipeline_move_execution_strategies(strategy):
     import numpy as np
 

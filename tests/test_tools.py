@@ -12,6 +12,20 @@ _ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": "/root/repo"}
 
 import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def metis_file(tmp_path_factory):
+    """Self-generated 1024-node METIS fixture (the reference checkout's
+    rgg2d.metis is not available in every container)."""
+    from kaminpar_tpu.graph import generators
+    from kaminpar_tpu.io.metis import write_metis
+
+    g = generators.rgg2d_graph(1024, seed=1)
+    path = tmp_path_factory.mktemp("tools") / "rgg2d.metis"
+    write_metis(g, str(path))
+    return str(path), int(g.n), int(g.m)
 
 
 def _run_tool(*args):
@@ -21,40 +35,40 @@ def _run_tool(*args):
     )
 
 
-def test_graph_properties_tool():
-    out = _run_tool("graph-properties", "/root/reference/misc/rgg2d.metis")
+def test_graph_properties_tool(metis_file):
+    path, n, m = metis_file
+    out = _run_tool("graph-properties", path)
     assert out.returncode == 0, out.stderr
-    assert "n: 1024" in out.stdout
-    assert "m: 4113" in out.stdout
+    assert f"n: {n}" in out.stdout
+    assert f"m: {m // 2}" in out.stdout
 
 
-def test_partition_properties_tool(tmp_path):
-    part = np.zeros(1024, dtype=np.int64)
-    part[512:] = 1
+def test_partition_properties_tool(metis_file, tmp_path):
+    path, n, _ = metis_file
+    part = np.zeros(n, dtype=np.int64)
+    part[n // 2:] = 1
     pfile = tmp_path / "p.part"
     np.savetxt(pfile, part, fmt="%d")
-    out = _run_tool(
-        "partition-properties", "/root/reference/misc/rgg2d.metis", str(pfile)
-    )
+    out = _run_tool("partition-properties", path, str(pfile))
     assert out.returncode == 0, out.stderr
     assert "k: 2" in out.stdout
     assert "cut:" in out.stdout
 
 
-def test_connected_components_tool():
-    out = _run_tool("connected-components", "/root/reference/misc/rgg2d.metis")
+def test_connected_components_tool(metis_file):
+    out = _run_tool("connected-components", metis_file[0])
     assert out.returncode == 0, out.stderr
     assert "Components:" in out.stdout
 
 
-def test_rearrange_tool(tmp_path):
+def test_rearrange_tool(metis_file, tmp_path):
     out_file = tmp_path / "rearranged.metis"
-    out = _run_tool("rearrange", "/root/reference/misc/rgg2d.metis", str(out_file))
+    out = _run_tool("rearrange", metis_file[0], str(out_file))
     assert out.returncode == 0, out.stderr
     from kaminpar_tpu.io.metis import read_metis
 
     g = read_metis(str(out_file))
-    assert g.n == 1024
+    assert g.n == metis_file[1]
 
 
 def test_heap_profiler_scopes():
@@ -91,7 +105,7 @@ def test_debug_dumps(tmp_path):
     assert any(p.suffix == ".part" for p in dumps), dumps
 
 
-def test_compression_tool():
-    out = _run_tool("compression", "/root/reference/misc/rgg2d.metis")
+def test_compression_tool(metis_file):
+    out = _run_tool("compression", metis_file[0])
     assert out.returncode == 0, out.stderr
     assert "ratio:" in out.stdout
