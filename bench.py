@@ -87,10 +87,11 @@ def run_lp_phase() -> dict:
     from kaminpar_tpu.graph.generators import rmat_graph
     from kaminpar_tpu.ops import lp, pallas_lp
     from kaminpar_tpu.utils import RandomState, next_key
-    from kaminpar_tpu.utils import compile_stats
+    from kaminpar_tpu.utils import compile_stats, sync_stats
 
     compile_stats.enable_compile_time_tracking()
     compile_stats.reset()
+    sync_stats.reset()
 
     dev = jax.devices()[0]
     backend = dev.platform
@@ -134,14 +135,17 @@ def run_lp_phase() -> dict:
     # Warmup: compile + one real round.  Sync via scalar readback: on the
     # tunneled TPU backend block_until_ready can return before execution
     # completes, so a device->host transfer is the only reliable fence.
-    state = one_round(state)
-    int(state.num_moved)
-
-    start = time.perf_counter()
-    for _ in range(rounds):
+    # Routed through sync_stats so the fences show up in the host_sync
+    # report rather than hiding from it.
+    with sync_stats.scoped("lp_bench_fence"):
         state = one_round(state)
-    int(state.num_moved)
-    elapsed = time.perf_counter() - start
+        sync_stats.pull(state.num_moved)
+
+        start = time.perf_counter()
+        for _ in range(rounds):
+            state = one_round(state)
+        sync_stats.pull(state.num_moved)
+        elapsed = time.perf_counter() - start
 
     edges_per_sec = graph.m * rounds / elapsed
     # Lower-bound HBM traffic per LP round: per directed edge one adjacency
@@ -153,6 +157,7 @@ def run_lp_phase() -> dict:
     est_gbps = bytes_lb * rounds / elapsed / 1e9
     hbm_peak = _hbm_peak(str(device_kind)) if on_accel else None
 
+    sync_snap = sync_stats.snapshot()
     record = {
         "metric": f"lp_clustering_throughput_rmat{scale}",
         "value": round(edges_per_sec, 1),
@@ -164,6 +169,10 @@ def run_lp_phase() -> dict:
         "est_hbm_gbps_lb": round(est_gbps, 1),
         "lp_kernel": lp_kernel,
         "lp_compile": compile_stats.compile_time_snapshot(),
+        # Blocking device->host transfer census of the microbench window
+        # (utils/sync_stats.py): count + bytes per timer phase.
+        "host_sync_count": sync_snap["count"],
+        "host_sync": sync_snap["phases"],
     }
     if hbm_peak:
         record["hbm_frac_of_peak_lb"] = round(est_gbps / hbm_peak, 4)
@@ -186,10 +195,11 @@ def run_full_phase(record: dict | None = None) -> dict:
     from kaminpar_tpu.kaminpar import KaMinPar
     from kaminpar_tpu.utils import RandomState
 
-    from kaminpar_tpu.utils import compile_stats
+    from kaminpar_tpu.utils import compile_stats, sync_stats
 
     compile_stats.enable_compile_time_tracking()
     compile_stats.reset()
+    sync_stats.reset()
 
     record = dict(record or {})
     backend = jax.devices()[0].platform
@@ -213,6 +223,7 @@ def run_full_phase(record: dict | None = None) -> dict:
     # buckets bound (ISSUE 1; one ~35-48 s compile per shape on a tunneled
     # TPU, TPU_NOTES.md).
     shape_counts = compile_stats.snapshot()
+    sync_snap = sync_stats.snapshot()
     record.update({
         "backend": record.get("backend", backend),
         "partition_wall_s": round(wall, 2),
@@ -222,6 +233,13 @@ def run_full_phase(record: dict | None = None) -> dict:
         "partition_edges_per_sec": round(fgraph.m / wall, 1),
         "compiled_shape_count": shape_counts,
         "partition_compile": compile_stats.compile_time_snapshot(),
+        # Blocking-transfer census of the full-partition phase: total count
+        # + per-phase {count, bytes} keyed by the timer tree's scope names
+        # (the one-batched-readback-per-coarsening-level contract shows up
+        # as host_sync.coarsening.count == hierarchy depth).
+        "host_sync_count": sync_snap["count"],
+        "host_sync_bytes": sync_snap["bytes"],
+        "host_sync": sync_snap["phases"],
     })
     print(json.dumps(record), flush=True)
     return record
@@ -384,7 +402,8 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
         if full_rec and "partition_wall_s" in full_rec:
             for key in ("partition_wall_s", "partition_cut", "partition_scale",
                         "partition_k", "partition_edges_per_sec",
-                        "compiled_shape_count", "partition_compile"):
+                        "compiled_shape_count", "partition_compile",
+                        "host_sync_count", "host_sync_bytes", "host_sync"):
                 if key in full_rec:
                     rec[key] = full_rec[key]
         else:

@@ -34,6 +34,10 @@ class ClusterCoarsener:
         self.ctx = ctx
         self.input_graph = graph
         self.hierarchy: List[CoarseLevel] = []
+        # Contraction count (levels attempted, including a final converged
+        # attempt that is not pushed) — the denominator of the
+        # one-blocking-readback-per-level budget deep.py asserts.
+        self.contractions = 0
         # v-cycle mode: per-node community ids of the *input* graph; LP never
         # merges across communities (reference: VcycleDeepMultilevelPartitioner
         # + accept_neighbor, lp_refiner.cc:108-110).
@@ -147,6 +151,11 @@ class ClusterCoarsener:
                     graph.row_ptr, graph.col_idx, graph.node_w, masked_ew,
                     sorted_by_degree=graph.sorted_by_degree, edge_u=graph.edge_u,
                 )
+                # Same structure as graph: share the layout inputs so the
+                # masked view costs no extra readback.
+                cluster_graph._deg_hist = graph._deg_hist
+                cluster_graph._layout_mode = graph._layout_mode
+                cluster_graph._host_row_ptr = graph._host_row_ptr
                 if isinstance(self.clusterer, LPClustering):
                     clusterer = LPClustering(
                         _dc.replace(
@@ -163,8 +172,21 @@ class ClusterCoarsener:
                     clusterer = self.clusterer
                 labels = clusterer.compute_clustering(cluster_graph, max_cw)
             else:
-                labels = self.clusterer.compute_clustering(graph, max_cw)
-            coarse, coarse_of = contract_clustering(graph, labels)
+                clusterer = self.clusterer
+                labels = clusterer.compute_clustering(graph, max_cw)
+            # The level's ONE blocking device->host readback: contraction
+            # packs n_c, m_c, the coarse max node weight / total edge
+            # weight, the degree histogram that seeds the coarse bucketed
+            # layout, and the clusterer's moved count into a single small
+            # array (ops/contraction.py stats layout).
+            lp_moved = getattr(clusterer, "last_num_moved", None)
+            self.contractions += 1
+            if lp_moved is not None:
+                coarse, coarse_of, (lp_moved,) = contract_clustering(
+                    graph, labels, extra_scalars=(lp_moved,)
+                )
+            else:
+                coarse, coarse_of = contract_clustering(graph, labels)
             coarse_comm = None
             if comm is not None:
                 # Clusters never span communities, so any member's id works.
@@ -193,7 +215,9 @@ class ClusterCoarsener:
         shrink = 1.0 - coarse.n / max(graph.n, 1)
         Logger.log(
             f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "
-            f"m={graph.m} -> {coarse.m} (max_cw={max_cw})",
+            f"m={graph.m} -> {coarse.m} (max_cw={max_cw}"
+            + (f", lp_moved={lp_moved}" if lp_moved is not None else "")
+            + ")",
             OutputLevel.DEBUG,
         )
         if shrink < self.ctx.coarsening.convergence_threshold:
@@ -212,5 +236,7 @@ class ClusterCoarsener:
     def uncoarsen(self, partition):
         """Pop one level, project the partition to the finer graph."""
         level = self.hierarchy.pop()
-        with scoped_timer("uncoarsening"):
-            return project_partition(level.coarse_of, partition)
+        with scoped_timer("uncoarsening", sync=True) as ts:
+            out = project_partition(level.coarse_of, partition)
+            ts.note(out)
+            return out

@@ -51,6 +51,9 @@ class LPClustering:
     ):
         self.ctx = ctx
         self.overlay_levels = max(int(overlay_levels), 1)
+        # Device scalar of the last clustering's final-round moved count;
+        # batched into the coarsening level's single readback.
+        self.last_num_moved = None
         # Set by the coarsener from the *input* graph's edge weights (the
         # gate must not flip mid-hierarchy as contraction accumulates
         # weights); see the weighted-graph mode note in _one_clustering.
@@ -79,8 +82,11 @@ class LPClustering:
 
     def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
         """Returns padded labels (over graph.padded()); pad nodes carry the
-        anchor label."""
-        with scoped_timer("lp_clustering"):
+        anchor label.  Fully device-resident: no blocking readback happens
+        here — the per-clustering moved count stays on device as
+        ``self.last_num_moved`` so the coarsener can batch it into the
+        level's single readback."""
+        with scoped_timer("lp_clustering", sync=True) as ts:
             labels = self._one_clustering(graph, max_cluster_weight)
             # Overlay: intersect independent clusterings (rounder clusters;
             # randomized-run variance cancels).  Intersection only splits
@@ -88,6 +94,7 @@ class LPClustering:
             for _ in range(self.overlay_levels - 1):
                 other = self._one_clustering(graph, max_cluster_weight)
                 labels = _intersect_clusterings(labels, other)
+            ts.note(labels)
         return labels
 
     def _one_clustering(self, graph: CSRGraph, max_cluster_weight: int):
@@ -161,4 +168,7 @@ class LPClustering:
                 max_w,
                 num_labels=n_pad,
             )
+        # Device scalar — NOT pulled here; the coarsener packs it into the
+        # level's single batched readback (contract_clustering).
+        self.last_num_moved = state.num_moved
         return state.labels

@@ -420,6 +420,18 @@ class ParallelContext:
     # applied at import in kaminpar_tpu/__init__.py) act as the fallback.
     persistent_compilation_cache: bool = True
     compilation_cache_dir: str = ""  # "" = env var or ~/.cache default
+    # Degree-bucketed layout construction backend (graph/csr.py):
+    # "host" = numpy over pulled CSR arrays (zero-copy on the CPU backend,
+    # a full-graph device->host round trip per hierarchy level through an
+    # accelerator tunnel); "device" = jitted gathers fed by the 12-int
+    # degree histogram riding each contraction level's single batched
+    # readback (no bulk transfer, a few small extra kernel shapes);
+    # "auto" = device on accelerator backends, host on CPU.
+    device_layout_build: str = "auto"
+    # Profiling aid (utils/timer.py): make sync-eligible timer scopes block
+    # on their sentinel so phase wall-clock measures compute, not dispatch.
+    # Off by default — it serializes the async pipeline it measures.
+    sync_timers: bool = False
 
 
 def configure_compilation_cache(parallel: ParallelContext) -> None:
@@ -464,6 +476,23 @@ def configure_compilation_cache(parallel: ParallelContext) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except Exception:  # pragma: no cover — the cache is an optimization only
         pass
+
+
+def configure_layout_build(parallel: ParallelContext) -> None:
+    """Apply the context's layout-build backend to graph construction
+    (graph/csr.py global; the KAMINPAR_TPU_LAYOUT_BUILD env var overrides).
+    Safe to call repeatedly; later calls win (the facade calls it per
+    KaMinPar(), the configure_compilation_cache pattern)."""
+    from .graph.csr import set_layout_build_mode
+
+    set_layout_build_mode(parallel.device_layout_build)
+
+
+def configure_sync_timers(parallel: ParallelContext) -> None:
+    """Apply the context's sync-timers profiling switch (utils/timer.py)."""
+    from .utils import timer
+
+    timer.set_sync_mode(parallel.sync_timers)
 
 
 @dataclass

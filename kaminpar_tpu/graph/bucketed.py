@@ -69,6 +69,57 @@ class BucketedView(NamedTuple):
 
 from ..utils.intmath import next_pow2 as _next_pow2
 
+# Width classes of the degree histogram that rides the contraction level's
+# batched readback (ops/contraction.py stats layout): class i holds nodes of
+# bucket width 2^(3+i); two trailing ints carry heavy row / slot counts.
+WIDTH_CLASSES = tuple(1 << (3 + i) for i in range(10))
+
+
+def degree_classes(deg, real):
+    """Device (width-class index 0..9, heavy mask) per node via integer
+    threshold counts — bit-identical to the host builder's float
+    ``pow2ceil`` width computation for every degree (exact comparisons, no
+    rounding)."""
+    import jax.numpy as _jnp
+
+    cls = _jnp.zeros_like(deg)
+    for t in WIDTH_CLASSES[:-1]:
+        cls = cls + (deg > t).astype(deg.dtype)
+    heavy = real & (deg > WIDTH_CLASSES[-1])
+    return cls, heavy
+
+
+def device_deg_histogram(deg, real):
+    """(12,) device ints: per-class real non-heavy node counts, heavy row
+    count, heavy slot count.  Trace-safe (called inside the contraction
+    kernel so the histogram ships in the level's single readback)."""
+    cls, heavy = degree_classes(deg, real)
+    ok = real & ~heavy
+    seg = jnp.where(ok, cls, len(WIDTH_CLASSES)).astype(jnp.int32)
+    hist = jax.ops.segment_sum(
+        jnp.ones_like(seg), seg, num_segments=len(WIDTH_CLASSES) + 1
+    )[:-1]
+    hr = jnp.sum(heavy.astype(jnp.int32))
+    hs = jnp.sum(jnp.where(heavy, deg, 0)).astype(jnp.int32)
+    return jnp.concatenate(
+        [hist.astype(deg.dtype), jnp.stack([hr, hs]).astype(deg.dtype)]
+    )
+
+
+def host_deg_histogram(row_ptr: np.ndarray, n: int) -> np.ndarray:
+    """Host twin of :func:`device_deg_histogram` for graphs built from
+    numpy (the finest level) — no device readback needed."""
+    deg = np.diff(np.asarray(row_ptr)[: n + 1]).astype(np.int64)
+    heavy = deg > WIDTH_CLASSES[-1]
+    cls = np.zeros(n, dtype=np.int64)
+    for t in WIDTH_CLASSES[:-1]:
+        cls += deg > t
+    counts = np.bincount(cls[~heavy], minlength=len(WIDTH_CLASSES))
+    return np.concatenate(
+        [counts[: len(WIDTH_CLASSES)],
+         [int(heavy.sum()), int(deg[heavy].sum())]]
+    ).astype(np.int64)
+
 
 def build_bucketed_view(
     row_ptr: np.ndarray,
@@ -167,4 +218,137 @@ def build_bucketed_view(
         heavy=heavy,
         gather_idx=jnp.asarray(offsets.astype(idt)),
         n=n,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-side builder: the layout is computed with jitted gathers on the
+# padded (shape-ladder) arrays; the ONLY host-side input is the 12-int degree
+# histogram, which for coarse graphs rides the contraction level's single
+# batched readback — so a coarsening level performs zero bulk device->host
+# transfers for layout construction.  Bit-identical to the host builder
+# (same class structure, same ascending node order, same pad conventions;
+# asserted in tests/test_bucketed.py).
+# ---------------------------------------------------------------------------
+
+
+def _merge_plan(hist, min_rows: int):
+    """Histogram twin of the host builder's width-class merge cascade.
+
+    Returns (plan, merged_to): ``plan`` is [(width, R, R_pad)] ascending for
+    every final occupied class; ``merged_to[i]`` is the final width of
+    original class i (0 for empty classes)."""
+    counts = {
+        w: int(hist[i]) for i, w in enumerate(WIDTH_CLASSES) if int(hist[i]) > 0
+    }
+    natural = sorted(counts)
+    groups = {w: [w] for w in natural}
+    for w in natural[:-1]:
+        cnt = counts.get(w, 0)
+        if 0 < cnt < min_rows:
+            bigger = min(x for x in natural if x > w)
+            counts[bigger] = counts.get(bigger, 0) + cnt
+            counts[w] = 0
+            groups.setdefault(bigger, [bigger]).extend(groups.pop(w))
+    merged_to = np.zeros(len(WIDTH_CLASSES), dtype=np.int32)
+    plan = []
+    for w in sorted(counts):
+        if counts[w] <= 0:
+            continue
+        for member in groups.get(w, [w]):
+            merged_to[WIDTH_CLASSES.index(member)] = w
+        plan.append((w, counts[w], _next_pow2(counts[w], 8)))
+    return plan, merged_to
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("w", "R_pad"), donate_argnums=(3,))
+def _device_bucket(row_ptr, col, ew, gather_idx, n, merged_to, base, R, *,
+                   w: int, R_pad: int):
+    idt = col.dtype
+    n_pad = row_ptr.shape[0] - 1
+    m_pad = col.shape[0]
+    anchor = n_pad - 1
+    deg = row_ptr[1:] - row_ptr[:-1]
+    real = jnp.arange(n_pad) < n
+    cls, heavy = degree_classes(deg, real)
+    mask = real & ~heavy & (merged_to[cls.astype(jnp.int32)] == w)
+    nodes = jnp.nonzero(mask, size=R_pad, fill_value=anchor)[0].astype(idt)
+    rows_ok = jnp.arange(R_pad) < R
+    degn = jnp.where(rows_ok, deg[nodes], 0)
+    slot = jnp.arange(w)
+    idx = row_ptr[nodes][:, None] + slot[None, :]
+    valid = slot[None, :] < degn[:, None]
+    safe = jnp.minimum(idx, m_pad - 1)
+    cols_b = jnp.where(valid, col[safe], nodes[:, None])
+    wgts_b = jnp.where(valid, ew[safe], 0)
+    rank = (jnp.cumsum(mask) - 1).astype(idt)
+    gi = jnp.where(mask, base.astype(idt) + rank, gather_idx)
+    return nodes, cols_b, wgts_b, gi
+
+
+@_partial(jax.jit, static_argnames=("Hr_pad", "Hs_pad"), donate_argnums=(4,))
+def _device_heavy(row_ptr, col, ew, edge_u, gather_idx, n, base, Hs, *,
+                  Hr_pad: int, Hs_pad: int):
+    idt = col.dtype
+    n_pad = row_ptr.shape[0] - 1
+    m_pad = col.shape[0]
+    anchor = n_pad - 1
+    deg = row_ptr[1:] - row_ptr[:-1]
+    real = jnp.arange(n_pad) < n
+    _, heavy = degree_classes(deg, real)
+    hnodes = jnp.nonzero(heavy, size=Hr_pad, fill_value=anchor)[0].astype(idt)
+    hrank = (jnp.cumsum(heavy) - 1).astype(idt)
+    # Heavy CSR slots ascending == host's per-node slot enumeration (pad
+    # edges belong to the anchor, which real-mask excludes from heavy).
+    edge_sel = heavy[edge_u]
+    hslots = jnp.nonzero(edge_sel, size=Hs_pad, fill_value=0)[0]
+    slot_ok = jnp.arange(Hs_pad) < Hs
+    safe = jnp.minimum(hslots, m_pad - 1)
+    hcols = jnp.where(slot_ok, col[safe], anchor).astype(idt)
+    hw = jnp.where(slot_ok, ew[safe], 0).astype(idt)
+    hrow = jnp.where(
+        slot_ok, hrank[edge_u[safe]], Hr_pad - 1
+    ).astype(idt)
+    gi = jnp.where(heavy, base.astype(idt) + hrank, gather_idx)
+    return hnodes, hrow, hcols, hw, gi
+
+
+def build_bucketed_view_device(pv, n: int, hist) -> BucketedView:
+    """Device-resident layout build over a :class:`PaddedView`.
+
+    ``hist``: the 12-int degree histogram (see :func:`device_deg_histogram`)
+    — the only host-side shape input.  Uses the default width configuration
+    (the histogram classes are fixed); the host builder remains the
+    configurable reference implementation."""
+    plan, merged_to = _merge_plan(hist, MIN_ROWS)
+    Hr, Hs = int(hist[len(WIDTH_CLASSES)]), int(hist[len(WIDTH_CLASSES) + 1])
+    idt = pv.col_idx.dtype
+    n_dev = jnp.asarray(n)
+    m2 = jnp.asarray(merged_to)
+    gather_idx = jnp.zeros(pv.n_pad, dtype=idt)
+    buckets = []
+    base = 0
+    for w, R, R_pad in plan:
+        nodes, cols_b, wgts_b, gather_idx = _device_bucket(
+            pv.row_ptr, pv.col_idx, pv.edge_w, gather_idx, n_dev, m2,
+            jnp.asarray(base), jnp.asarray(R), w=w, R_pad=R_pad,
+        )
+        buckets.append(Bucket(nodes, cols_b, wgts_b))
+        base += R_pad
+    if Hr:
+        Hr_pad = _next_pow2(Hr + 1, 8)  # strictly > Hr: last row is a pad
+        Hs_pad = _next_pow2(Hs, 8)
+        hnodes, hrow, hcols, hw, gather_idx = _device_heavy(
+            pv.row_ptr, pv.col_idx, pv.edge_w, pv.edge_u, gather_idx, n_dev,
+            jnp.asarray(base), jnp.asarray(Hs), Hr_pad=Hr_pad, Hs_pad=Hs_pad,
+        )
+        heavy = HeavyPart(hnodes, hrow, hcols, hw)
+    else:
+        z = jnp.zeros(0, dtype=idt)
+        heavy = HeavyPart(z, z, z, z)
+    return BucketedView(
+        buckets=tuple(buckets), heavy=heavy, gather_idx=gather_idx[:n], n=n
     )

@@ -39,6 +39,42 @@ def _next_bucket(x: int, minimum: int = 256) -> int:
     return next_shape_bucket(x, minimum)
 
 
+# Degree-bucketed layout construction backend: "host" (numpy over pulled
+# CSR arrays — zero-copy on the CPU backend, a full-graph device->host
+# round trip per hierarchy level on an accelerator), "device" (jitted
+# gathers fed by the 12-int degree histogram that rides the contraction
+# level's single batched readback — no bulk transfer), or "auto" (device
+# on accelerator backends).  Set via ParallelContext.device_layout_build
+# through context.configure_layout_build(), or KAMINPAR_TPU_LAYOUT_BUILD.
+_layout_build_mode = "auto"
+
+
+def set_layout_build_mode(mode: str) -> None:
+    if mode not in ("host", "device", "auto"):
+        raise ValueError(
+            f"layout build mode must be 'host', 'device' or 'auto', got {mode!r}"
+        )
+    global _layout_build_mode
+    _layout_build_mode = mode
+
+
+def resolve_layout_build_mode(override: Optional[str] = None) -> str:
+    """Env kill switch > per-graph override (CSRGraph._layout_mode, pinned
+    by the facade and inherited through contraction — two KaMinPar
+    instances with different settings must not reconfigure each other's
+    graphs) > process default."""
+    import os
+
+    mode = (
+        os.environ.get("KAMINPAR_TPU_LAYOUT_BUILD", "")
+        or override
+        or _layout_build_mode
+    )
+    if mode == "auto":
+        return "device" if jax.default_backend() != "cpu" else "host"
+    return mode
+
+
 class PaddedView(NamedTuple):
     """Shape-bucketed view of a CSRGraph for jitted kernels.
 
@@ -108,16 +144,34 @@ class CSRGraph:
         self.n = n
         self.m = m
         self.sorted_by_degree = sorted_by_degree
+        # Host copy of row_ptr when construction started from numpy — lets
+        # edge_u / the degree histogram come for free instead of via a pull.
+        self._host_row_ptr = (
+            np.asarray(row_ptr) if isinstance(row_ptr, np.ndarray) else None
+        )
         # Source endpoint per CSR slot: edge_u[e] = u for e in [row_ptr[u], row_ptr[u+1]).
-        # Callers sharing structure with another graph can pass its edge_u.
+        # Callers sharing structure with another graph can pass its edge_u
+        # (contraction passes the coarse sources it already has on device).
         self.edge_u = (
-            _compute_edge_u(self.row_ptr, m) if edge_u is None else jnp.asarray(edge_u)
+            _compute_edge_u(
+                self.row_ptr if self._host_row_ptr is None else self._host_row_ptr,
+                m,
+            )
+            if edge_u is None
+            else jnp.asarray(edge_u)
         )
         self._total_node_weight: Optional[int] = None
         self._max_node_weight: Optional[int] = None
         self._total_edge_weight: Optional[int] = None
         self._padded: Optional[PaddedView] = None
         self._bucketed = None
+        # (12,) host ints: per-width-class node counts + heavy row/slot
+        # counts (ops/contraction.py stats layout).  Seeded by contraction
+        # for coarse graphs so the device layout build needs no readback.
+        self._deg_hist = None
+        # Per-graph layout-build mode override (None = process default);
+        # pinned by the owning facade, inherited by coarse/masked graphs.
+        self._layout_mode: Optional[str] = None
 
     def padded(self) -> PaddedView:
         """Shape-bucketed view (cached); see :class:`PaddedView`."""
@@ -139,7 +193,12 @@ class CSRGraph:
             )
             node_w = jnp.concatenate([self.node_w, jnp.zeros(n_fill, dtype=idt)])
             edge_w = jnp.concatenate([self.edge_w, jnp.zeros(m_fill, dtype=idt)])
-            edge_u = _compute_edge_u(row_ptr, m_pad)
+            # All pad edges belong to the anchor (the pad rows before it are
+            # empty), so the padded sources extend edge_u in place — no
+            # host-side recomputation, no device->host transfer.
+            edge_u = jnp.concatenate(
+                [self.edge_u, jnp.full(m_fill, n_pad - 1, dtype=idt)]
+            )
             from ..utils import compile_stats
 
             # Census of (n_pad, m_pad) shape buckets actually materialized —
@@ -153,40 +212,84 @@ class CSRGraph:
     def bucketed(self):
         """Degree-bucketed adjacency view (cached); see graph/bucketed.py.
         Indexed against the PaddedView's node space (labels arrays are
-        (n_pad,), pad cols point at the anchor)."""
-        if self._bucketed is None:
-            from .bucketed import build_bucketed_view
+        (n_pad,), pad cols point at the anchor).
 
+        Built on device (gathers fed by the degree histogram, no bulk
+        device->host transfer) or on host per the layout-build mode; the
+        two builders produce bit-identical views (asserted in
+        tests/test_bucketed.py)."""
+        if self._bucketed is None:
             pv = self.padded()
-            self._bucketed = build_bucketed_view(
-                np.asarray(self.row_ptr),
-                np.asarray(self.col_idx),
-                np.asarray(self.edge_w),
-                self.n,
-                pv.anchor,
-            )
+            if resolve_layout_build_mode(self._layout_mode) == "device":
+                from .bucketed import build_bucketed_view_device
+
+                self._bucketed = build_bucketed_view_device(
+                    pv, self.n, self.deg_histogram()
+                )
+            else:
+                from ..utils import sync_stats
+                from .bucketed import build_bucketed_view
+
+                host_arrays = sync_stats.pull(
+                    self.row_ptr, self.col_idx, self.edge_w
+                )
+                self._bucketed = build_bucketed_view(
+                    *host_arrays, self.n, pv.anchor
+                )
         return self._bucketed
+
+    def deg_histogram(self):
+        """(12,) host ints: width-class node counts + heavy row/slot counts
+        (the device layout build's only host-side input).  Seeded by
+        contraction for coarse graphs; otherwise derived from the host
+        row_ptr when available, else via one 12-int readback."""
+        if self._deg_hist is None:
+            if self._host_row_ptr is not None:
+                from .bucketed import host_deg_histogram
+
+                self._deg_hist = host_deg_histogram(self._host_row_ptr, self.n)
+            else:
+                from ..utils import sync_stats
+                from .bucketed import device_deg_histogram
+
+                pv = self.padded()
+                deg = pv.row_ptr[1:] - pv.row_ptr[:-1]
+                real = jnp.arange(pv.n_pad) < pv.n
+                self._deg_hist = sync_stats.pull(
+                    jax.jit(device_deg_histogram)(deg, real)
+                ).astype(int)
+        return self._deg_hist
 
     # -- scalar properties (host) -----------------------------------------
 
     @property
     def total_node_weight(self) -> int:
         if self._total_node_weight is None:
-            self._total_node_weight = int(np.asarray(self.node_w, dtype=np.int64).sum())
+            from ..utils import sync_stats
+
+            self._total_node_weight = int(
+                sync_stats.pull(self.node_w).astype(np.int64).sum()
+            )
         return self._total_node_weight
 
     @property
     def max_node_weight(self) -> int:
         if self._max_node_weight is None:
+            from ..utils import sync_stats
+
             self._max_node_weight = (
-                int(jnp.max(self.node_w)) if self.n > 0 else 0
+                int(sync_stats.pull(jnp.max(self.node_w))) if self.n > 0 else 0
             )
         return self._max_node_weight
 
     @property
     def total_edge_weight(self) -> int:
         if self._total_edge_weight is None:
-            self._total_edge_weight = int(np.asarray(self.edge_w, dtype=np.int64).sum())
+            from ..utils import sync_stats
+
+            self._total_edge_weight = int(
+                sync_stats.pull(self.edge_w).astype(np.int64).sum()
+            )
         return self._total_edge_weight
 
     def degrees(self):
@@ -196,11 +299,16 @@ class CSRGraph:
         return bool(jnp.all(self.node_w == 1)) and bool(jnp.all(self.edge_w == 1))
 
     def has_uniform_edge_weights(self) -> bool:
-        """All edge weights equal (device-side reduce; only scalars reach
-        the host).  Gates the weighted clustering mode (lp_clusterer.py)."""
+        """All edge weights equal (device-side reduce; only a scalar reaches
+        the host, as a counted pull).  Gates the weighted clustering mode
+        (lp_clusterer.py)."""
         if self.m == 0:
             return True
-        return bool(jnp.min(self.edge_w) == jnp.max(self.edge_w))
+        from ..utils import sync_stats
+
+        return bool(
+            sync_stats.pull(jnp.min(self.edge_w) == jnp.max(self.edge_w))
+        )
 
     def device_put(self, device=None) -> "CSRGraph":
         g = CSRGraph.__new__(CSRGraph)
@@ -213,6 +321,9 @@ class CSRGraph:
         g._total_edge_weight = self._total_edge_weight
         g._padded = None
         g._bucketed = None
+        g._deg_hist = self._deg_hist
+        g._host_row_ptr = self._host_row_ptr
+        g._layout_mode = self._layout_mode
         return g
 
     def __repr__(self):
@@ -224,9 +335,16 @@ def _compute_edge_u(row_ptr, m: int):
 
     Computed host-side with ``np.repeat`` — graph construction is host
     orchestration, and a device expression of this (scatter + max-scan) costs
-    a fresh XLA compile per hierarchy-level shape for zero benefit.
+    a fresh XLA compile per hierarchy-level shape for zero benefit.  Coarse
+    graphs never reach here: contraction hands the sources it already has on
+    device to the constructor.
     """
-    rp = np.asarray(row_ptr)
+    if isinstance(row_ptr, np.ndarray):
+        rp = row_ptr
+    else:
+        from ..utils import sync_stats
+
+        rp = sync_stats.pull(row_ptr)
     dtype = rp.dtype
     if m == 0:
         return jnp.zeros(0, dtype=dtype)

@@ -40,9 +40,15 @@ class KaMinPar:
         self.ctx = ctx
         # Persistent compilation cache per the context's parallel settings
         # (the env-var defaults applied at package import are the fallback).
-        from .context import configure_compilation_cache
+        from .context import (
+            configure_compilation_cache,
+            configure_layout_build,
+            configure_sync_timers,
+        )
 
         configure_compilation_cache(ctx.parallel)
+        configure_layout_build(ctx.parallel)
+        configure_sync_timers(ctx.parallel)
         self.graph: Optional[CSRGraph] = None
         self.compressed_graph: Optional[object] = None
         self._last: Optional[PartitionedGraph] = None
@@ -91,6 +97,12 @@ class KaMinPar:
             graph = None
         else:
             self.compressed_graph = None
+        if graph is not None:
+            # Pin this facade's layout-build mode on the graph itself so two
+            # KaMinPar instances with different settings cannot reconfigure
+            # each other's graphs through the process default; coarse and
+            # masked graphs inherit the pin.
+            graph._layout_mode = self.ctx.parallel.device_layout_build
         self.graph = graph
 
     def copy_graph(

@@ -111,3 +111,11 @@ def num_colors(colors, node_mask) -> int:
 
     c = np.asarray(colors)[np.asarray(node_mask)]
     return int(c.max()) + 1 if len(c) else 1
+
+
+@jax.jit
+def num_colors_device(colors, node_mask):
+    """Device scalar color count — same value as :func:`num_colors` (pads
+    hold color 0, so the masked max is the real max) without shipping the
+    whole color array to the host; callers batch the pull."""
+    return jnp.max(jnp.where(node_mask, colors, 0)).astype(jnp.int32) + 1

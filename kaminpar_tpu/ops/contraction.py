@@ -10,23 +10,54 @@ TPU the whole thing is the classic sort-reduce (SURVEY §7 stage 4):
 3. sort edges by (coarse_u, coarse_v) and sum weights per run,
 4. compact runs to the front and build the coarse CSR.
 
-All device work uses static (fine-graph) shapes; the dynamically-sized coarse
-graph is extracted by the host with two scalar transfers (n_c, m_c) per level
-— the multilevel loop is host orchestration anyway (SURVEY §7 design stance).
+Device-residency contract (ISSUE 2): all device work uses static
+(fine-bucket) shapes, the coarse graph is extracted into *padded device
+buffers* on the geometric shape ladder (one fused slice+pad kernel, fine
+buffers donated so the ladder does not accumulate HBM copies), and the host
+learns everything it needs about a level — ``n_c``, ``m_c``, the coarse max
+node weight, the coarse total edge weight, the degree histogram that seeds
+the bucketed layout, plus any caller scalars (LP moved-count) — from ONE
+batched scalar readback per level (``utils/sync_stats.pull``).
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
-from ..graph.csr import CSRGraph
+from ..graph.bucketed import WIDTH_CLASSES, device_deg_histogram
+from ..graph.csr import CSRGraph, PaddedView, _next_bucket
+from ..utils import sync_stats
 from .segment import run_ids, run_starts2
 
+# stats layout: [n_c_full, m_c, max_node_w, total_edge_w, hist*10, Hr, Hs]
+STATS_LEN = 4 + len(WIDTH_CLASSES) + 2
 
-@jax.jit
+
+def _edge_sort_perm(ku, kv, sentinel: int):
+    """Permutation sorting edges by (ku, kv) with original order on ties.
+
+    Single fused-key ``lax.sort`` when the composite key fits the widest
+    enabled integer dtype (one sort pass carrying 2 operands with a scalar
+    comparator), else the two-key ``jnp.lexsort`` (one pass carrying 3
+    operands with a lexicographic comparator — the measurably slower
+    shape on TPU).  Both are stable, so the permutations are identical
+    element-for-element (asserted in tests/test_contraction.py).
+    """
+    m = ku.shape[0]
+    kdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    if (sentinel + 1) * (sentinel + 2) <= jnp.iinfo(kdt).max:
+        key = ku.astype(kdt) * (sentinel + 1) + kv.astype(kdt)
+        iota = jnp.arange(m, dtype=jnp.int32)
+        _, order = jax.lax.sort((key, iota), dimension=0, num_keys=1)
+        return order
+    return jnp.lexsort((kv, ku))
+
+
+@partial(jax.jit, donate_argnums=(0,))
 def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     from ..utils import compile_stats
 
@@ -52,7 +83,7 @@ def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     keep = cu != cv
     ku = jnp.where(keep, cu, n)  # sentinel key sorts dropped edges last
     kv = jnp.where(keep, cv, 0)
-    order = jnp.lexsort((kv, ku))
+    order = _edge_sort_perm(ku, kv, n)
     su, sv = ku[order], kv[order]
     sw = jnp.where(keep[order], edge_w[order], 0)
     first = run_starts2(su, sv)
@@ -68,7 +99,7 @@ def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     out_w = jnp.zeros(m, dtype=edge_w.dtype).at[pos].set(run_w[rid], mode="drop")
     m_c = jnp.sum(valid)
 
-    # coarse row_ptr over the full n-slot buffer (host slices to n_c+1)
+    # coarse row_ptr over the full n-slot buffer (sliced to n_c+1 later)
     deg_c = jax.ops.segment_sum(
         valid.astype(jnp.int32), jnp.where(valid, su, 0).astype(jnp.int32), num_segments=n
     )
@@ -78,34 +109,136 @@ def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     row_ptr = jnp.concatenate(
         [jnp.zeros(1, dtype=idt), jnp.cumsum(deg_c).astype(idt)]
     )
-    return coarse_of, n_c, m_c, c_node_w, out_u, out_v, out_w, row_ptr
+
+    # Per-level host scalars, batched: everything the orchestration loop
+    # needs to know about this level in ONE small array (pulled once by
+    # contract_clustering).  The degree histogram covers the real coarse
+    # nodes (the pure-padding anchor cluster, always last, has degree 0 and
+    # is excluded along with the n_c slice).
+    real = jnp.arange(n, dtype=jnp.int32) < (n_c - 1)
+    # Weight totals accumulate in the widest enabled integer dtype; in the
+    # default 32-bit build that is int32, which is exact under the repo-wide
+    # invariant that total node/edge weight stays below 2^31 (ops/lp.py
+    # module contract — every weight reduction in the system shares it; the
+    # 64-bit build carries int64 end to end).
+    wsum_dt = jnp.int64 if jax.config.jax_enable_x64 else idt
+    stats = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    n_c.astype(idt),
+                    m_c.astype(idt),
+                    jnp.max(c_node_w).astype(idt),
+                    jnp.sum(out_w.astype(wsum_dt)).astype(idt),
+                ]
+            ),
+            device_deg_histogram(deg_c.astype(idt), real),
+        ]
+    )
+    return coarse_of, stats, c_node_w, out_u, out_v, out_w, row_ptr
 
 
-def contract_clustering(graph: CSRGraph, labels_padded) -> Tuple[CSRGraph, jax.Array]:
+@partial(jax.jit, static_argnames=("n_pad", "m_pad"))
+def _extract_padded(row_ptr, c_node_w, out_u, out_v, out_w, n_c, m_c, *,
+                    n_pad: int, m_pad: int):
+    """Slice+pad the fine-bucket contraction buffers straight into the coarse
+    graph's PaddedView arrays (geometric shape ladder): pad nodes weight-0 /
+    degree-0, pad edges weight-0 anchor self-loops.  The fine-sized inputs
+    die with this call (their handles are dropped by contract_clustering),
+    so the only survivors of a level are bucket-sized — donation is useless
+    here because XLA cannot alias across the shape change."""
+    idt = row_ptr.dtype
+    anchor = jnp.asarray(n_pad - 1, dtype=idt)
+    n1 = row_ptr.shape[0] - 1
+
+    i_n1 = jnp.arange(n_pad + 1)
+    rp = jnp.where(
+        i_n1 <= n_c,
+        row_ptr[jnp.minimum(i_n1, n1)],
+        m_c.astype(idt),
+    ).at[-1].set(jnp.asarray(m_pad, dtype=idt))
+
+    i_n = jnp.arange(n_pad)
+    node_ok = i_n < n_c
+    safe_n = jnp.minimum(i_n, n1 - 1)
+    nw = jnp.where(node_ok, c_node_w[safe_n], 0).astype(idt)
+
+    i_m = jnp.arange(m_pad)
+    edge_ok = i_m < m_c
+    safe_m = jnp.minimum(i_m, out_v.shape[0] - 1)
+    col = jnp.where(edge_ok, out_v[safe_m], anchor).astype(idt)
+    eu = jnp.where(edge_ok, out_u[safe_m], anchor).astype(idt)
+    ew = jnp.where(edge_ok, out_w[safe_m], 0).astype(idt)
+    return rp, col, nw, ew, eu
+
+
+def contract_clustering(
+    graph: CSRGraph, labels_padded, *, extra_scalars=()
+) -> Tuple[CSRGraph, jax.Array]:
     """Contract a clustering of graph's nodes into a coarse graph.
 
     ``labels_padded`` covers the graph's :class:`PaddedView` (pad nodes carry
     the anchor label, forming one pure-padding cluster that is sliced off —
     it is always the *last* coarse id since the anchor is the largest label).
+    The labels buffer is donated to the kernel.
+
     Returns ``(coarse_graph, coarse_of)`` where ``coarse_of[u]`` is the coarse
     node id of fine node ``u`` — the projection map used by uncoarsening
     (reference: ``CoarseGraph::project_up``,
     coarsening/abstract_cluster_coarsener.cc:148-170).
+
+    ``extra_scalars``: device scalars the caller wants in the level's single
+    batched readback (the coarsener packs the LP moved-count here); their
+    host values are returned as a third element when given.
+
+    One-readback contract: this function performs exactly ONE blocking
+    device->host transfer (the packed stats + extras vector).  The coarse
+    CSRGraph comes back with its PaddedView, degree histogram,
+    ``total_node_weight`` / ``max_node_weight`` / ``total_edge_weight``, and
+    ``edge_u`` pre-seeded, so no later property access re-syncs the level.
     """
     pv = graph.padded()
-    coarse_of, n_c, m_c, c_node_w, out_u, out_v, out_w, row_ptr = _contract_device(
+    coarse_of, stats, c_node_w, out_u, out_v, out_w, row_ptr = _contract_device(
         jnp.asarray(labels_padded), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w
     )
-    n_c = int(n_c) - 1  # drop the pure-padding anchor cluster (always last)
-    m_c = int(m_c)
-    idt = graph.row_ptr.dtype
-    coarse = CSRGraph(
-        row_ptr[: n_c + 1],
-        out_v[:m_c].astype(idt),
-        c_node_w[:n_c].astype(idt),
-        out_w[:m_c].astype(idt),
+    if extra_scalars:
+        idt = stats.dtype
+        stats = jnp.concatenate(
+            [stats, jnp.stack([jnp.asarray(x).astype(idt) for x in extra_scalars])]
+        )
+    stats_np = sync_stats.pull(stats)  # THE one blocking transfer of the level
+    n_c = int(stats_np[0]) - 1  # drop the pure-padding anchor cluster (always last)
+    m_c = int(stats_np[1])
+    n_pad = _next_bucket(n_c)
+    m_pad = _next_bucket(m_c)
+    rp_p, col_p, nw_p, ew_p, eu_p = _extract_padded(
+        row_ptr, c_node_w, out_u, out_v, out_w,
+        jnp.asarray(n_c), jnp.asarray(m_c), n_pad=n_pad, m_pad=m_pad,
     )
-    return coarse, coarse_of[: graph.n]
+
+    coarse = CSRGraph(
+        rp_p[: n_c + 1],
+        col_p[:m_c],
+        nw_p[:n_c],
+        ew_p[:m_c],
+        edge_u=eu_p[:m_c],
+    )
+    # Seed everything a later phase would otherwise sync for.
+    coarse._padded = PaddedView(rp_p, col_p, nw_p, ew_p, eu_p, n_c, m_c)
+    from ..utils import compile_stats
+
+    compile_stats.record("padded_bucket", statics=(n_pad, m_pad))
+    coarse._layout_mode = graph._layout_mode
+    if graph._total_node_weight is not None:
+        # Contraction conserves total node weight (pads are weight-0).
+        coarse._total_node_weight = graph._total_node_weight
+    coarse._max_node_weight = int(stats_np[2])
+    coarse._total_edge_weight = int(stats_np[3])
+    coarse._deg_hist = stats_np[4:STATS_LEN].astype(int)
+    out = (coarse, coarse_of[: graph.n])
+    if extra_scalars:
+        return out + (tuple(int(x) for x in stats_np[STATS_LEN:]),)
+    return out
 
 
 @jax.jit

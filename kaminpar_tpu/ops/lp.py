@@ -412,7 +412,61 @@ def lp_round_colored(
 
 @partial(
     jax.jit,
+    static_argnames=("num_labels", "allow_tie_moves"),
+    donate_argnums=(0,),
+)
+def clp_iterate_colors(
+    state: LPState,
+    keys,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    colors,
+    num_colors,
+    *,
+    num_labels: int,
+    allow_tie_moves: bool = True,
+) -> LPState:
+    """One full CLP iteration — every color class's superstep fused into one
+    on-device ``fori_loop`` — so an iteration costs one dispatch and one
+    batched moved-count readback instead of one of each per superstep (the
+    device-resident analog of the clp_refiner.cc superstep loop).
+
+    ``keys`` is the per-superstep key array drawn by the host in the exact
+    pre-fusion order (one ``next_key()`` per color; pad rows beyond
+    ``num_colors`` are never read), so the fused iteration is bit-identical
+    to the dispatch-per-superstep loop it replaces.  The returned state
+    carries the iteration's total moved count."""
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "clp_iterate",
+        arrays=[node_w, keys, *(b.cols for b in buckets), heavy.cols],
+        statics=("xla", num_labels, allow_tie_moves),
+    )
+
+    def body(c, carry):
+        st, moved = carry
+        st = lp_round_colored(
+            st, keys[c], buckets, heavy, gather_idx, node_w,
+            max_label_weights, colors == c, num_labels=num_labels,
+            allow_tie_moves=allow_tie_moves,
+        )
+        return st, moved + st.num_moved
+
+    state, moved = jax.lax.fori_loop(
+        0, jnp.asarray(num_colors, dtype=jnp.int32), body,
+        (state, jnp.int32(0)),
+    )
+    return state._replace(num_moved=moved)
+
+
+@partial(
+    jax.jit,
     static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+    donate_argnums=(0,),
 )
 def lp_iterate_bucketed(
     state: LPState,
@@ -436,7 +490,12 @@ def lp_iterate_bucketed(
     host-loop equivalent of lp_clusterer.cc:94-105).  ``max_iterations`` is a
     traced scalar (like ``min_moved``): it only feeds the while-loop cond, and
     keeping it dynamic means one compile per shape bucket even when the
-    low-degree boost varies the sweep budget across levels."""
+    low-degree boost varies the sweep budget across levels.
+
+    The input state is donated: callers hand over a freshly built
+    ``init_state`` and receive the converged state aliased into the same
+    HBM buffers — the v-cycle ladder holds one live LP state per level, not
+    one per dispatch."""
     from ..utils import compile_stats
 
     # Trace-time record: fires once per XLA specialization of this kernel

@@ -89,12 +89,16 @@ def _interpret() -> bool:
 
 
 def select_lp_ops(choice: str):
-    """(iterate, colored_round) pair for the configured ``lp_kernel`` knob —
-    the single dispatch point shared by lp_clusterer / lp_refiner /
-    clp_refiner."""
+    """(iterate, colored_round, colored_iterate) triple for the configured
+    ``lp_kernel`` knob — the single dispatch point shared by lp_clusterer /
+    lp_refiner / clp_refiner."""
     if resolve_lp_kernel(choice) == "pallas":
-        return lp_iterate_bucketed, lp_round_colored
-    return lp_ops.lp_iterate_bucketed, lp_ops.lp_round_colored
+        return lp_iterate_bucketed, lp_round_colored, clp_iterate_colors
+    return (
+        lp_ops.lp_iterate_bucketed,
+        lp_ops.lp_round_colored,
+        lp_ops.clp_iterate_colors,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -505,7 +509,55 @@ def lp_round_colored(
 
 @partial(
     jax.jit,
+    static_argnames=("num_labels", "allow_tie_moves"),
+    donate_argnums=(0,),
+)
+def clp_iterate_colors(
+    state: LPState,
+    keys,
+    buckets,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    colors,
+    num_colors,
+    *,
+    num_labels: int,
+    allow_tie_moves: bool = True,
+) -> LPState:
+    """Fused-kernel CLP iteration: all color supersteps in one on-device
+    fori_loop — bit-identical to lp.clp_iterate_colors (same per-superstep
+    keys, same round math), one dispatch + one moved-count readback per
+    iteration."""
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "clp_iterate",
+        arrays=[node_w, keys, *(b.cols for b in buckets), heavy.cols],
+        statics=("pallas", num_labels, allow_tie_moves),
+    )
+
+    def body(c, carry):
+        st, moved = carry
+        st = lp_round_colored(
+            st, keys[c], buckets, heavy, gather_idx, node_w,
+            max_label_weights, colors == c, num_labels=num_labels,
+            allow_tie_moves=allow_tie_moves,
+        )
+        return st, moved + st.num_moved
+
+    state, moved = jax.lax.fori_loop(
+        0, jnp.asarray(num_colors, dtype=jnp.int32), body,
+        (state, jnp.int32(0)),
+    )
+    return state._replace(num_moved=moved)
+
+
+@partial(
+    jax.jit,
     static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+    donate_argnums=(0,),
 )
 def lp_iterate_bucketed(
     state: LPState,

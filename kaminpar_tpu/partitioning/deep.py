@@ -19,7 +19,7 @@ from ..factories import create_refiner
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
 from ..initial.bipartitioner import extract_all_subgraphs, recursive_bipartition
-from ..utils import RandomState
+from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .kway import graph_to_host
@@ -208,8 +208,7 @@ class DeepMultilevelPartitioner:
         off_cur = split_offsets(k, cur_k)
         off_prev = split_offsets(k, self.communities_k)
         blk_comm = np.searchsorted(off_prev, off_cur[:cur_k], side="right") - 1
-        part = np.asarray(p_graph.partition)
-        comm = np.asarray(communities)
+        part, comm = sync_stats.pull(p_graph.partition, communities)
         bad = blk_comm[part] != comm
         if bad.any():
             part = np.where(bad, np.asarray(pre_part), part)
@@ -239,17 +238,21 @@ class DeepMultilevelPartitioner:
             graph.row_ptr, graph.col_idx, graph.node_w, masked_ew,
             sorted_by_degree=graph.sorted_by_degree, edge_u=graph.edge_u,
         )
+        mg._deg_hist = graph._deg_hist
+        mg._layout_mode = graph._layout_mode
+        mg._host_row_ptr = graph._host_row_ptr
         pv = mg.padded()
         bv = mg.bucketed()
         max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
         labels = pv.pad_node_array(p_graph.partition, 0)
         for _ in range(self.ctx.refinement.balancer.max_num_rounds):
-            labels, num_moved, still = _balance_round(
+            labels, flags = _balance_round(
                 next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
                 pv.node_w, max_bw, k=p_graph.k,
                 group_of=jnp.asarray(blk_comm, dtype=jnp.int32),
             )
-            if not bool(still) or int(num_moved) == 0:
+            num_moved, still = sync_stats.pull(flags)
+            if not still or num_moved == 0:
                 break
         return p_graph.with_partition(labels[: pv.n])
 
@@ -292,7 +295,11 @@ class DeepMultilevelPartitioner:
             coarsener.set_communities(self.communities)
 
         with scoped_timer("partitioning"):
+            sync_pre = sync_stats.phase_count("coarsening")
             coarsest = coarsener.coarsen(k, ctx.partition.epsilon, 2 * C)
+            sync_stats.assert_phase_budget(
+                "coarsening", coarsener.contractions, since=sync_pre
+            )
             if self.compressed is not None and coarsener.num_levels > 0:
                 # Drop every reference to the finest CSR: coarse-level
                 # work proceeds with only the compressed form + coarse
@@ -316,11 +323,13 @@ class DeepMultilevelPartitioner:
                 with scoped_timer("initial_partitioning"):
                     pass
             else:
-                host = graph_to_host(coarsest)
                 budgets = intermediate_block_weights(
                     np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
                 )
                 with scoped_timer("initial_partitioning"):
+                    # Host phase by design (the reference is sequential here
+                    # too); its bulk pull is attributed to this scope.
+                    host = graph_to_host(coarsest)
                     part = recursive_bipartition(
                         host, cur_k, budgets, rng, ctx.initial_partitioning
                     )
@@ -339,7 +348,8 @@ class DeepMultilevelPartitioner:
                 if cur_k < target_k:
                     with scoped_timer("extend_partition"):
                         part = extend_partition(
-                            graph, np.asarray(p_graph.partition), cur_k, target_k, ctx
+                            graph, sync_stats.pull(p_graph.partition), cur_k,
+                            target_k, ctx,
                         )
                     if debug:
                         from ..graph import metrics as _m

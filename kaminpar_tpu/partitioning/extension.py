@@ -91,7 +91,7 @@ def _restricted_refine(graph, part, comm, new_k, parent_of_new, inter_bw, ctx):
     from ..graph.csr import CSRGraph
     from ..ops import lp as lp_ops
     from ..refinement.balancer import _balance_round
-    from ..utils import next_key
+    from ..utils import next_key, sync_stats
 
     masked_ew = jnp.where(
         comm[graph.edge_u] == comm[graph.col_idx], graph.edge_w, 0
@@ -100,6 +100,9 @@ def _restricted_refine(graph, part, comm, new_k, parent_of_new, inter_bw, ctx):
         graph.row_ptr, graph.col_idx, graph.node_w, masked_ew,
         sorted_by_degree=graph.sorted_by_degree, edge_u=graph.edge_u,
     )
+    mg._deg_hist = graph._deg_hist
+    mg._layout_mode = graph._layout_mode
+    mg._host_row_ptr = graph._host_row_ptr
     pv = mg.padded()
     bv = mg.bucketed()
     # Relax caps by the level's max node weight (deep._refine's coarse
@@ -115,11 +118,12 @@ def _restricted_refine(graph, part, comm, new_k, parent_of_new, inter_bw, ctx):
     group_of = jnp.asarray(parent_of_new)
 
     for _ in range(ctx.refinement.balancer.max_num_rounds):
-        labels, num_moved, still = _balance_round(
+        labels, flags = _balance_round(
             next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
             pv.node_w, max_bw, k=new_k, group_of=group_of,
         )
-        if not bool(still) or int(num_moved) == 0:
+        num_moved, still = sync_stats.pull(flags)
+        if not still or num_moved == 0:
             break
 
     lctx = ctx.refinement.lp

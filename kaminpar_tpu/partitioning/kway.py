@@ -22,11 +22,16 @@ from ..utils.timer import scoped_timer
 
 
 def graph_to_host(graph: CSRGraph) -> HostCSR:
+    from ..utils import sync_stats
+
+    rp, col, nw, ew = sync_stats.pull(
+        graph.row_ptr, graph.col_idx, graph.node_w, graph.edge_w
+    )
     return HostCSR(
-        np.asarray(graph.row_ptr).astype(np.int64),
-        np.asarray(graph.col_idx).astype(np.int64),
-        np.asarray(graph.node_w).astype(np.int64),
-        np.asarray(graph.edge_w).astype(np.int64),
+        rp.astype(np.int64),
+        col.astype(np.int64),
+        nw.astype(np.int64),
+        ew.astype(np.int64),
     )
 
 

@@ -21,6 +21,7 @@ import numpy as np
 from ..context import Context
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
+from ..utils import sync_stats
 from ..utils.logger import Logger, OutputLevel
 from .deep import DeepMultilevelPartitioner
 from .partition_utils import intermediate_block_weights, split_offsets
@@ -77,7 +78,9 @@ class VcycleDeepMultilevelPartitioner:
                 communities_k=communities_k,
             )
             p_graph = partitioner.partition()
-            communities = np.asarray(p_graph.partition)
+            # One counted pull per cycle: the next cycle's community labels
+            # are host inputs to its coarsener construction.
+            communities = sync_stats.pull(p_graph.partition)
             communities_k = step_k
 
         return PartitionedGraph.create(

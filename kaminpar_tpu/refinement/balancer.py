@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..context import BalancerContext
 from ..graph.partitioned import PartitionedGraph
 from ..ops.bucketed_gains import bucketed_best_moves
-from ..utils import next_key
+from ..utils import next_key, sync_stats
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
 
@@ -94,7 +94,12 @@ def _balance_round(
     new_labels = jnp.where(commit, target, labels)
     new_bw = jax.ops.segment_sum(node_w, new_labels, num_segments=k)
     still_overloaded = jnp.any(new_bw > max_bw)
-    return new_labels, jnp.sum(commit).astype(jnp.int32), still_overloaded
+    # (num_moved, still_overloaded) packed so the host loop's convergence
+    # check costs ONE batched readback per round, not two scalar pulls.
+    flags = jnp.stack(
+        [jnp.sum(commit).astype(jnp.int32), still_overloaded.astype(jnp.int32)]
+    )
+    return new_labels, flags
 
 
 def _admit_by_budget(mask, block_of, rel, node_w, budget, k: int, *, inclusive: bool):
@@ -222,7 +227,10 @@ def _underload_round(
     new_labels = jnp.where(commit, target, labels)
     new_bw = jax.ops.segment_sum(node_w, new_labels, num_segments=k)
     still_underloaded = jnp.any(new_bw < min_bw)
-    return new_labels, jnp.sum(commit).astype(jnp.int32), still_underloaded
+    flags = jnp.stack(
+        [jnp.sum(commit).astype(jnp.int32), still_underloaded.astype(jnp.int32)]
+    )
+    return new_labels, flags
 
 
 class UnderloadBalancer(Refiner):
@@ -249,11 +257,12 @@ class UnderloadBalancer(Refiner):
         labels = pv.pad_node_array(p_graph.partition, 0)
         with scoped_timer("underload_balancer"):
             for _ in range(self.ctx.max_num_rounds):
-                labels, num_moved, still = _underload_round(
+                labels, flags = _underload_round(
                     next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
                     pv.node_w, max_bw, min_bw, k=p_graph.k,
                 )
-                if not bool(still) or int(num_moved) == 0:
+                num_moved, still = sync_stats.pull(flags)
+                if not still or num_moved == 0:
                     break
         return p_graph.with_partition(labels[: pv.n])
 
@@ -269,12 +278,13 @@ class OverloadBalancer(Refiner):
         labels = pv.pad_node_array(p_graph.partition, 0)
         with scoped_timer("overload_balancer"):
             for _ in range(self.ctx.max_num_rounds):
-                labels, num_moved, still = _balance_round(
+                labels, flags = _balance_round(
                     next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
                     pv.node_w, max_bw, k=p_graph.k,
                 )
-                if not bool(still):
+                num_moved, still = sync_stats.pull(flags)
+                if not still:
                     break
-                if int(num_moved) == 0:
+                if num_moved == 0:
                     break  # stuck: no feasible moves (cluster balancer territory)
         return p_graph.with_partition(labels[: pv.n])

@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from ..context import ColoredLPContext
 from ..graph.partitioned import PartitionedGraph
 from ..ops import lp
-from ..ops.coloring import color_graph, num_colors
-from ..utils import next_key
+from ..ops.coloring import color_graph, num_colors_device
+from ..utils import next_key, sync_stats
+from ..utils.intmath import next_pow2
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
 
@@ -49,29 +50,40 @@ class CLPRefiner(Refiner):
             )
         part = pv.pad_node_array(p_graph.partition, 0)
 
-        with scoped_timer("clp_refinement"):
+        with scoped_timer("clp_refinement", sync=True) as ts:
             mask = jnp.arange(pv.n_pad) < pv.n
             colors = color_graph(next_key(), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
-            nc = num_colors(colors, mask)
+            # The color count gates the host key draws below, so it is the
+            # one scalar this refiner must pull before iterating.
+            nc = int(sync_stats.pull(num_colors_device(colors, mask)))
 
             from ..ops.pallas_lp import select_lp_ops
 
-            round_colored = select_lp_ops(self.ctx.lp_kernel)[1]
+            iterate_colors = select_lp_ops(self.ctx.lp_kernel)[2]
             state = lp.init_state(part, pv.node_w, k_pad)
             before = p_graph.edge_cut()
+            # Key array shape is bucketed so the fused iteration compiles
+            # once per graph bucket, not once per color count; pad keys
+            # repeat key 0 and are never consumed (fori stops at nc).
+            nc_pad = next_pow2(nc, 4)
             for it in range(self.ctx.num_iterations):
-                moved = 0
-                for c in range(nc):
-                    state = round_colored(
-                        state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
-                        pv.node_w, max_w, colors == c, num_labels=k_pad,
-                        allow_tie_moves=self.ctx.allow_tie_moves,
-                    )
-                    moved += int(state.num_moved)
-                if moved == 0:
+                # One next_key() per superstep, drawn in the exact order of
+                # the pre-fusion dispatch-per-superstep loop.
+                keys = [next_key() for _ in range(nc)]
+                keys = jnp.stack(keys + [keys[0]] * (nc_pad - nc))
+                state = iterate_colors(
+                    state, keys, bv.buckets, bv.heavy, bv.gather_idx,
+                    pv.node_w, max_w, colors, jnp.int32(nc),
+                    num_labels=k_pad,
+                    allow_tie_moves=self.ctx.allow_tie_moves,
+                )
+                # One batched readback per iteration (the supersteps'
+                # moved counts are summed on device).
+                if int(sync_stats.pull(state.num_moved)) == 0:
                     break
             # Tie diffusion can wander; keep the better of (input, refined).
             out = p_graph.with_partition(state.labels[: pv.n])
+            ts.note(out.partition)
             if out.edge_cut() > before:
                 return p_graph
         return out

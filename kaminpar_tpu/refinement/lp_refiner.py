@@ -42,7 +42,10 @@ class LPRefiner(Refiner):
         from ..ops.pallas_lp import select_lp_ops
 
         iterate = select_lp_ops(self.ctx.lp_kernel)[0]
-        with scoped_timer("lp_refinement"):
+        with scoped_timer("lp_refinement", sync=True) as ts:
+            # One dispatch, zero readbacks: the sweep loop and its
+            # convergence test run on device (lp.lp_iterate_bucketed), and
+            # the state carry is donated into the kernel.
             state = iterate(
                 state,
                 next_key(),
@@ -57,4 +60,5 @@ class LPRefiner(Refiner):
                 active_prob=self.ctx.active_prob,
                 allow_tie_moves=self.ctx.allow_tie_moves,
             )
+            ts.note(state.labels)
         return p_graph.with_partition(state.labels[: pv.n])

@@ -1,3 +1,4 @@
+from . import sync_stats
 from .assertions import assertion_level, kassert, kassert_heavy, set_assertion_level
 from .logger import Logger, OutputLevel, log_result_line
 from .platform import force_cpu_devices
@@ -16,6 +17,7 @@ __all__ = [
     "next_key",
     "reseed",
     "set_assertion_level",
+    "sync_stats",
     "Timer",
     "scoped_timer",
 ]

@@ -1,0 +1,258 @@
+"""Blocking host<->device synchronization accounting.
+
+On-silicon profiling (TPU_NOTES.md r5) showed the full-partition wall
+dominated by host orchestration: every blocking dispatch round-trip costs
+~70 ms through the tunnel and every device->host scalar pull (``int(n_c)``,
+``int(m_c)``, per-round moved counts) serializes the dispatch pipeline.
+This module makes the *blocking-transfer count* a first-class,
+regression-testable metric, mirroring what :mod:`utils.compile_stats` does
+for compiled-shape counts:
+
+- :func:`pull` is the one sanctioned device->host readback primitive: it
+  blocks, converts to numpy, and counts one transfer (plus its bytes) per
+  array against the current phase.  Orchestration code packs its per-level
+  scalars into a single small array so a coarsening level performs exactly
+  one ``pull``.
+- Phases come from the timer tree: :func:`scoped_timer
+  <kaminpar_tpu.utils.timer.scoped_timer>` pushes its scope name as the
+  active sync phase, so transfer counts line up with the wall-clock report
+  for free.
+- :func:`tripwire` patches the jax array scalar-conversion dunders
+  (``__int__`` / ``__float__`` / ``__bool__`` / ``item``) to count *implicit*
+  pulls — the ``int(x)``-style strays the device-resident spine must not
+  contain.  Tests run inside it and assert the implicit count stays zero.
+- :func:`guard` additionally arms jax's transfer guard (effective on
+  accelerator backends; the CPU backend's zero-copy host arrays never
+  trigger it, which is why the tripwire exists).
+
+``bench.py`` embeds :func:`snapshot` in its headline JSON
+(``host_sync_count`` + per-phase bytes) and the deep partitioner asserts the
+one-readback-per-coarsening-level budget through :func:`phase_count` when
+:func:`enable_budget_checks` is armed (single-pipeline test runs; the
+counters are process-global, so concurrent replica threads would alias each
+other's budgets).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+# phase -> [explicit_count, explicit_bytes, implicit_count, implicit_bytes]
+_counts: Dict[str, list] = {}
+_tls = threading.local()
+_budget_checks = False
+_DEFAULT_PHASE = "untracked"
+
+
+def _phase() -> str:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else _DEFAULT_PHASE
+
+
+def push_phase(name: str) -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(name)
+
+
+def pop_phase() -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+@contextmanager
+def scoped(name: str):
+    """Attribute transfers inside the block to phase ``name`` (the timer
+    tree pushes its scope names through this automatically)."""
+    push_phase(name)
+    try:
+        yield
+    finally:
+        pop_phase()
+
+
+def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None) -> None:
+    ph = phase or _phase()
+    with _lock:
+        row = _counts.get(ph)
+        if row is None:
+            row = _counts[ph] = [0, 0, 0, 0]
+        row[kind_offset] += count
+        row[kind_offset + 1] += nbytes
+
+
+def pull(*arrays, phase: str | None = None):
+    """The sanctioned blocking device->host readback: materialize each array
+    on the host, counting one blocking transfer (and its bytes) per array
+    against the current phase.  Callers batch their per-level scalars into
+    ONE array so one ``pull`` == one transfer.
+
+    Returns a single ndarray for one input, else a tuple of ndarrays.
+    """
+    import jax
+
+    out = []
+    # The explicit allow makes pull() the sanctioned escape hatch inside
+    # guard(): strays raise, batched readbacks pass.
+    with jax.transfer_guard_device_to_host("allow"):
+        for a in arrays:
+            host = np.asarray(a)
+            _bump(0, 1, int(host.nbytes), phase)
+            out.append(host)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def record_transfer(nbytes: int, count: int = 1, phase: str | None = None) -> None:
+    """Count a blocking transfer performed outside :func:`pull` (host layout
+    builders that consume numpy views of device arrays)."""
+    _bump(0, count, int(nbytes), phase)
+
+
+def phase_count(name: str, implicit: bool = False) -> int:
+    with _lock:
+        row = _counts.get(name)
+        if row is None:
+            return 0
+        return row[2] if implicit else row[0]
+
+
+def snapshot() -> dict:
+    """{phase: {count, bytes, implicit, implicit_bytes}} plus totals."""
+    with _lock:
+        phases = {
+            k: {
+                "count": v[0],
+                "bytes": v[1],
+                "implicit": v[2],
+                "implicit_bytes": v[3],
+            }
+            for k, v in sorted(_counts.items())
+        }
+    return {
+        "phases": phases,
+        "count": sum(p["count"] for p in phases.values()),
+        "bytes": sum(p["bytes"] for p in phases.values()),
+        "implicit": sum(p["implicit"] for p in phases.values()),
+    }
+
+
+def reset() -> None:
+    with _lock:
+        _counts.clear()
+
+
+def enable_budget_checks(on: bool = True) -> None:
+    """Arm the in-pipeline budget assertions (deep.py).  Off by default:
+    the counters are process-global and concurrent best-of-R replica
+    threads would trip each other's budgets."""
+    global _budget_checks
+    _budget_checks = bool(on)
+
+
+def budget_checks_enabled() -> bool:
+    return _budget_checks
+
+
+def assert_phase_budget(name: str, budget: int, since: int = 0) -> None:
+    """Raise when phase ``name`` performed more than ``budget`` blocking
+    transfers since the ``since`` snapshot (see :func:`phase_count`).
+    No-op unless :func:`enable_budget_checks` armed it."""
+    if not _budget_checks:
+        return
+    used = phase_count(name) - since
+    if used > budget:
+        raise AssertionError(
+            f"sync budget exceeded in phase {name!r}: {used} blocking "
+            f"transfers > budget {budget} (one batched readback per level "
+            f"is the contract; see utils/sync_stats.py)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Implicit-sync tripwire: count int()/float()/bool()/.item() on jax arrays.
+# ---------------------------------------------------------------------------
+
+_trip_depth = 0
+_trip_saved: Dict[str, object] = {}
+_TRIP_METHODS: Tuple[str, ...] = ("__int__", "__float__", "__bool__", "item")
+
+
+def _array_type():
+    import jax
+
+    return type(jax.numpy.zeros(0))
+
+
+def _install_tripwire() -> None:
+    cls = _array_type()
+    for name in _TRIP_METHODS:
+        orig = getattr(cls, name, None)
+        if orig is None:  # pragma: no cover - dunder set varies by jaxlib
+            continue
+        _trip_saved[name] = orig
+
+        def make(orig):
+            def patched(self, *args, **kwargs):
+                try:
+                    _bump(2, 1, int(getattr(self, "nbytes", 0) or 0))
+                except Exception:  # noqa: BLE001 - accounting must never break math
+                    pass
+                return orig(self, *args, **kwargs)
+
+            return patched
+
+        setattr(cls, name, make(orig))
+
+
+def _uninstall_tripwire() -> None:
+    cls = _array_type()
+    for name, orig in _trip_saved.items():
+        setattr(cls, name, orig)
+    _trip_saved.clear()
+
+
+@contextmanager
+def tripwire():
+    """Count implicit scalar pulls (``int(x)``/``float(x)``/``bool(x)``/
+    ``.item()`` on device arrays) while active.  Nests; test-scoped — the
+    patched dunders add a few ns to every jax-array scalar conversion."""
+    global _trip_depth
+    with _lock:
+        _trip_depth += 1
+        if _trip_depth == 1:
+            _install_tripwire()
+    try:
+        yield
+    finally:
+        with _lock:
+            _trip_depth -= 1
+            if _trip_depth == 0:
+                _uninstall_tripwire()
+
+
+@contextmanager
+def guard():
+    """Disallow implicit device->host transfers at the jax runtime level.
+    Effective on accelerator backends (raises on any transfer not routed
+    through an explicit allow); the CPU backend's host-resident arrays never
+    trigger it — pair with :func:`tripwire` for CPU CI."""
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+@contextmanager
+def allow_transfers():
+    """Escape hatch inside :func:`guard` for a sanctioned pull."""
+    import jax
+
+    with jax.transfer_guard_device_to_host("allow"):
+        yield

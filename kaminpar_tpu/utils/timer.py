@@ -117,12 +117,62 @@ class Timer:
         return "TIME " + " ".join(parts)
 
 
+class SyncSentinel:
+    """Mutable sentinel holder yielded by :func:`scoped_timer`.
+
+    Under async dispatch a timer scope measures *dispatch* time, not compute;
+    a scope that ends with device work notes a result array here and, when
+    sync mode is on, the scope blocks on it before recording elapsed time so
+    the compute is attributed to the right timer."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def note(self, x) -> None:
+        self.value = x
+
+
+_sync_mode = False
+
+
+def set_sync_mode(on: bool) -> None:
+    """Profiling mode: make ``scoped_timer(..., sync=True)`` scopes block on
+    their noted sentinel before closing.  Off by default — blocking at every
+    phase boundary serializes the async dispatch pipeline the device-resident
+    spine exists to keep full (it adds waits, never transfers; the
+    sync_stats budget is unaffected)."""
+    global _sync_mode
+    _sync_mode = bool(on)
+
+
+def sync_mode() -> bool:
+    return _sync_mode
+
+
 @contextmanager
-def scoped_timer(name: str):
+def scoped_timer(name: str, sync: bool = False):
     """``SCOPED_TIMER`` + ``SCOPED_HEAP_PROFILER`` equivalent (timer.h /
-    heap_profiler.h macro APIs — the reference pairs them on every scope)."""
+    heap_profiler.h macro APIs — the reference pairs them on every scope).
+
+    Also pushes ``name`` as the active :mod:`utils.sync_stats` phase so
+    blocking-transfer counts line up with the timer tree.  ``sync=True``
+    marks a scope that ends with in-flight device work: the scope yields a
+    :class:`SyncSentinel`, and when :func:`set_sync_mode` is on the scope
+    calls ``jax.block_until_ready`` on the noted array before recording its
+    elapsed time."""
+    from . import sync_stats
     from .heap_profiler import HeapProfiler
 
+    sentinel = SyncSentinel()
     with Timer.global_().scope(name):
         with HeapProfiler.scope(name):
-            yield
+            with sync_stats.scoped(name):
+                try:
+                    yield sentinel
+                finally:
+                    if sync and _sync_mode and sentinel.value is not None:
+                        import jax
+
+                        jax.block_until_ready(sentinel.value)

@@ -190,7 +190,8 @@ def run_attempt(attempt: int) -> dict | None:
         if pallas:
             best["pallas_lp"] = {
                 key: pallas[-1].get(key)
-                for key in ("value", "unit", "vs_baseline", "lp_compile")
+                for key in ("value", "unit", "vs_baseline", "lp_compile",
+                            "host_sync_count", "host_sync")
                 if key in pallas[-1]
             }
         best["probe_attempt"] = attempt

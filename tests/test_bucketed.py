@@ -175,3 +175,89 @@ def test_lp_iterate_bucketed(rng):
     lab = np.asarray(out.labels)[: graph.n]
     assert len(np.unique(lab)) < graph.n  # clustering actually happened
     assert np.asarray(out.label_weights).max() <= 12
+
+
+# ---------------------------------------------------------------------------
+# Device-side layout build (ISSUE 2): bit-identical to the host builder.
+# ---------------------------------------------------------------------------
+
+
+def _assert_views_equal(a, b):
+    assert len(a.buckets) == len(b.buckets), (len(a.buckets), len(b.buckets))
+    for i, (ba, bb) in enumerate(zip(a.buckets, b.buckets)):
+        for name in ("nodes", "cols", "wgts"):
+            xa, xb = np.asarray(getattr(ba, name)), np.asarray(getattr(bb, name))
+            assert xa.shape == xb.shape, (i, name, xa.shape, xb.shape)
+            assert np.array_equal(xa, xb), (i, name)
+    for name in ("nodes", "row", "cols", "wgts"):
+        assert np.array_equal(
+            np.asarray(getattr(a.heavy, name)), np.asarray(getattr(b.heavy, name))
+        ), name
+    assert np.array_equal(np.asarray(a.gather_idx), np.asarray(b.gather_idx))
+    assert a.n == b.n
+
+
+@pytest.mark.parametrize("gname", ["rmat", "grid", "star", "heavy_star"])
+def test_device_layout_build_matches_host(gname):
+    from kaminpar_tpu.graph.bucketed import build_bucketed_view_device
+
+    graphs = {
+        "rmat": lambda: generators.rmat_graph(10, 8, seed=5),
+        "grid": lambda: generators.grid2d_graph(40, 40),
+        "star": lambda: generators.star_graph(200),
+        # center degree 4999 > MAX_WIDTH: exercises the heavy part
+        "heavy_star": lambda: generators.star_graph(5000),
+    }
+    g = graphs[gname]()
+    pv = g.padded()
+    host = build_bucketed_view(
+        np.asarray(g.row_ptr), np.asarray(g.col_idx), np.asarray(g.edge_w),
+        g.n, pv.anchor,
+    )
+    dev = build_bucketed_view_device(pv, g.n, g.deg_histogram())
+    _assert_views_equal(host, dev)
+
+
+def test_deg_histogram_host_device_agree():
+    from kaminpar_tpu.graph.bucketed import (
+        device_deg_histogram, host_deg_histogram,
+    )
+
+    for g in (generators.rmat_graph(10, 8, seed=6), generators.star_graph(5000)):
+        pv = g.padded()
+        deg = pv.row_ptr[1:] - pv.row_ptr[:-1]
+        real = jnp.arange(pv.n_pad) < pv.n
+        dev = np.asarray(jax.jit(device_deg_histogram)(deg, real))
+        host = host_deg_histogram(np.asarray(g.row_ptr), g.n)
+        assert np.array_equal(dev.astype(np.int64), host), (dev, host)
+
+
+def test_lp_round_identical_on_device_layout():
+    """An LP round over the device-built layout commits exactly the same
+    labels as over the host-built layout (the layouts are bit-identical,
+    so the kernel results must be too)."""
+    from kaminpar_tpu.graph.bucketed import build_bucketed_view_device
+    from kaminpar_tpu.utils import reseed
+
+    g = generators.rmat_graph(10, 8, seed=8)
+    pv = g.padded()
+    host = build_bucketed_view(
+        np.asarray(g.row_ptr), np.asarray(g.col_idx), np.asarray(g.edge_w),
+        g.n, pv.anchor,
+    )
+    dev = build_bucketed_view_device(pv, g.n, g.deg_histogram())
+    idt = pv.row_ptr.dtype
+    labels = jnp.concatenate(
+        [jnp.arange(pv.n, dtype=idt), jnp.full(pv.n_pad - pv.n, pv.anchor, dtype=idt)]
+    )
+    max_w = jnp.asarray(30, dtype=idt)
+    outs = {}
+    for name, bv in (("host", host), ("device", dev)):
+        reseed(21)
+        state = lp.init_state(labels, pv.node_w, pv.n_pad)
+        state = lp.lp_round_bucketed(
+            state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
+            pv.node_w, max_w, num_labels=pv.n_pad,
+        )
+        outs[name] = np.asarray(state.labels)
+    assert np.array_equal(outs["host"], outs["device"])

@@ -64,3 +64,65 @@ def test_clp_never_worsens():
     pg = _pgraph(g, 4, part)
     out = CLPRefiner(ColoredLPContext()).refine(pg)
     assert out.edge_cut() <= pg.edge_cut()
+
+
+def test_clp_fused_supersteps_bit_identical_to_host_loop():
+    """The device-resident CLP iteration (one fori_loop over color classes,
+    one batched moved-count readback) is bit-identical to the
+    dispatch-per-superstep host loop it replaced (ISSUE 2): same key draws
+    in the same order, same rounds, same early break."""
+    from kaminpar_tpu.ops.coloring import num_colors_device
+    from kaminpar_tpu.utils import next_key, reseed, sync_stats
+
+    def host_loop_clp(p_graph, ctx):
+        from kaminpar_tpu.ops import lp
+
+        pv = p_graph.graph.padded()
+        bv = p_graph.graph.bucketed()
+        k = p_graph.k
+        k_pad = lp.num_labels_bucket(k)
+        max_w = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
+        if k_pad > k:
+            max_w = jnp.concatenate([max_w, jnp.zeros(k_pad - k, dtype=max_w.dtype)])
+        part = pv.pad_node_array(p_graph.partition, 0)
+        mask = jnp.arange(pv.n_pad) < pv.n
+        colors = color_graph(next_key(), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
+        nc = num_colors(colors, mask)
+        state = lp.init_state(part, pv.node_w, k_pad)
+        before = p_graph.edge_cut()
+        for _ in range(ctx.num_iterations):
+            moved = 0
+            for c in range(nc):
+                state = lp.lp_round_colored(
+                    state, next_key(), bv.buckets, bv.heavy, bv.gather_idx,
+                    pv.node_w, max_w, colors == c, num_labels=k_pad,
+                    allow_tie_moves=ctx.allow_tie_moves,
+                )
+                moved += int(state.num_moved)
+            if moved == 0:
+                break
+        out = p_graph.with_partition(state.labels[: pv.n])
+        return p_graph if out.edge_cut() > before else out
+
+    for g in (generators.grid2d_graph(16, 16), generators.rmat_graph(9, 8, seed=3)):
+        rng = np.random.default_rng(9)
+        part = rng.integers(0, 4, g.n).astype(np.int32)
+        reseed(31)
+        ref = host_loop_clp(_pgraph(g, 4, part), ColoredLPContext())
+        reseed(31)
+        sync_stats.reset()
+        fused = CLPRefiner(ColoredLPContext()).refine(_pgraph(g, 4, part))
+        assert np.array_equal(np.asarray(ref.partition), np.asarray(fused.partition))
+        # fused path: 1 color-count pull + 1 moved-count pull per iteration
+        phases = sync_stats.snapshot()["phases"]
+        assert phases["clp_refinement"]["count"] <= 1 + ColoredLPContext().num_iterations
+
+
+def test_num_colors_device_matches_host():
+    from kaminpar_tpu.ops.coloring import num_colors_device
+
+    for g in (generators.grid2d_graph(12, 12), generators.rmat_graph(8, 8, seed=4)):
+        pv = g.padded()
+        mask = jnp.arange(pv.n_pad) < pv.n
+        colors = color_graph(jax.random.PRNGKey(3), pv.edge_u, pv.col_idx, mask, n=pv.n_pad)
+        assert int(num_colors_device(colors, mask)) == num_colors(colors, mask)

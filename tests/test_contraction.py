@@ -78,6 +78,101 @@ def test_contract_preserves_cut_weight():
     assert coarse.total_node_weight == g.total_node_weight
 
 
+def test_contract_zero_degree_coarse_nodes():
+    """Clusters whose every edge is internal become zero-degree coarse
+    nodes; their rows must exist with matching row_ptr entries."""
+    # two disjoint triangles + one isolated node; each triangle a cluster
+    edges = np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5]])
+    g = from_edge_list(7, edges)
+    labels = np.array([0, 0, 0, 3, 3, 3, 6])
+    coarse, coarse_of = contract_clustering(g, _pad_labels(g, labels))
+    validate(coarse)
+    assert coarse.n == 3
+    assert coarse.m == 0  # all edges intra-cluster
+    assert np.asarray(coarse.row_ptr).tolist() == [0, 0, 0, 0]
+    assert np.asarray(coarse.node_w).tolist() == [3, 3, 1]
+    assert coarse.total_node_weight == 7
+    assert coarse.max_node_weight == 3
+    assert coarse.total_edge_weight == 0
+
+
+def test_contract_single_cluster_level_metadata():
+    """All-edges-dropped (single-cluster) level: the padded view and the
+    seeded metadata stay consistent."""
+    g = generators.complete_graph(6)
+    labels = np.zeros(6, dtype=np.int64)
+    coarse, _ = contract_clustering(g, _pad_labels(g, labels))
+    assert coarse.n == 1 and coarse.m == 0
+    assert coarse.max_node_weight == 6
+    assert coarse.total_edge_weight == 0
+    pv = coarse.padded()
+    assert pv.n == 1 and pv.m == 0
+    # pure-padding region: zero weights, anchor self-loop cols
+    assert np.asarray(pv.node_w)[1:].sum() == 0
+    assert (np.asarray(pv.col_idx) == pv.anchor).all()
+    assert np.asarray(pv.edge_w).sum() == 0
+
+
+def test_contract_padded_view_anchor_slicing():
+    """The seeded coarse PaddedView must match what csr.padded() would
+    build from the sliced arrays (the pure-padding anchor cluster is
+    sliced off, pad rows collapse onto m_c, pad edges are weight-0 anchor
+    self-loops)."""
+    from kaminpar_tpu.graph.csr import CSRGraph
+
+    g = generators.rmat_graph(9, 8, seed=11)
+    rng = np.random.default_rng(4)
+    labels = rng.integers(0, 60, g.n)
+    coarse, _ = contract_clustering(g, _pad_labels(g, labels))
+    assert coarse._padded is not None  # seeded, not rebuilt
+    rebuilt = CSRGraph(
+        np.asarray(coarse.row_ptr), np.asarray(coarse.col_idx),
+        np.asarray(coarse.node_w), np.asarray(coarse.edge_w),
+    ).padded()
+    seeded = coarse.padded()
+    assert seeded.n == rebuilt.n and seeded.m == rebuilt.m
+    for name in ("row_ptr", "col_idx", "node_w", "edge_w", "edge_u"):
+        assert np.array_equal(
+            np.asarray(getattr(seeded, name)), np.asarray(getattr(rebuilt, name))
+        ), name
+
+
+def test_fused_sort_matches_lexsort():
+    """The fused single-key edge sort is permutation-identical to the
+    two-key lexsort (both stable), so coarse graphs are bit-identical."""
+    import jax
+
+    from kaminpar_tpu.ops import contraction as C
+
+    rng = np.random.default_rng(7)
+    n = 500
+    ku = jnp.asarray(rng.integers(0, n + 1, 4096).astype(np.int32))
+    kv = jnp.asarray(rng.integers(0, n, 4096).astype(np.int32))
+    fused = C._edge_sort_perm(ku, kv, n)  # n small: fused path
+    ref = jnp.lexsort((kv, ku))
+    assert np.array_equal(np.asarray(fused), np.asarray(ref))
+
+    # whole-kernel check: force the lexsort path and compare coarse graphs
+    g = generators.rmat_graph(9, 8, seed=13)
+    labels = rng.integers(0, 80, g.n)
+    coarse_fused, of_fused = contract_clustering(g, _pad_labels(g, labels))
+    orig = C._edge_sort_perm
+    C._edge_sort_perm = lambda ku, kv, sentinel: jnp.lexsort((kv, ku))
+    try:
+        jax.clear_caches()  # _contract_device already traced the fused path
+        coarse_lex, of_lex = contract_clustering(g, _pad_labels(g, labels))
+    finally:
+        C._edge_sort_perm = orig
+        jax.clear_caches()
+    assert coarse_fused.n == coarse_lex.n and coarse_fused.m == coarse_lex.m
+    for attr in ("row_ptr", "col_idx", "node_w", "edge_w", "edge_u"):
+        assert np.array_equal(
+            np.asarray(getattr(coarse_fused, attr)),
+            np.asarray(getattr(coarse_lex, attr)),
+        ), attr
+    assert np.array_equal(np.asarray(of_fused), np.asarray(of_lex))
+
+
 def test_local_contraction_matches_global():
     """contract_local_clustering (local_contraction.cc role) must produce
     the SAME coarse graph as the global path for a shard-local clustering

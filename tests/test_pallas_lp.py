@@ -151,8 +151,11 @@ def test_iterate_bit_identical():
     key = next_key()
     args = (bv.buckets, bv.heavy, bv.gather_idx, pv.node_w, max_w,
             jnp.int32(1), jnp.int32(4))
+    # The iterate entry points donate their state carry — each call gets an
+    # independently built state.
     st_x = lp.lp_iterate_bucketed(state, key, *args, num_labels=pv.n_pad)
-    st_p = pallas_lp.lp_iterate_bucketed(state, key, *args, num_labels=pv.n_pad)
+    _, _, state2 = _init(g)
+    st_p = pallas_lp.lp_iterate_bucketed(state2, key, *args, num_labels=pv.n_pad)
     _assert_state_equal(st_x, st_p)
 
 
