@@ -434,23 +434,63 @@ class ParallelContext:
     sync_timers: bool = False
 
 
+# First-wins records of the process-global settings the configure_* entry
+# points have applied: group name -> the settings tuple that won.  A second
+# facade/engine instance re-applying *identical* settings is a no-op; a
+# *conflicting* one warns and leaves the first application untouched (it
+# must not clobber global JAX/layout config out from under a live engine).
+_configured: dict = {}
+
+
+def _configure_once(group: str, settings: tuple, apply) -> None:
+    prev = _configured.get(group)
+    if prev is None:
+        apply()
+        _configured[group] = settings
+        return
+    if prev != settings:
+        import warnings
+
+        warnings.warn(
+            f"kaminpar_tpu: conflicting {group} settings {settings!r} ignored — "
+            f"this process already applied {prev!r}.  Process-global "
+            "configuration is first-wins; run the differing instance in its "
+            "own process or call context.reset_global_configuration() first.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+def reset_global_configuration() -> None:
+    """Forget the first-wins configure_* records so the next facade/engine
+    instance re-applies its settings (tests and long-lived REPLs)."""
+    _configured.clear()
+
+
 def configure_compilation_cache(parallel: ParallelContext) -> None:
     """Apply the context's persistent-cache settings to the live jax config.
 
     Reference for why AOT executable caching stays off: the round-3 CPU
-    serializer crashes (see kaminpar_tpu/__init__.py).  Safe to call
-    repeatedly; later calls win (the facade calls it per KaMinPar()).
+    serializer crashes (see kaminpar_tpu/__init__.py).  Idempotent and
+    re-entrancy-safe: the first facade/engine instance wins; identical later
+    settings are a no-op and conflicting ones warn instead of clobbering.
     """
     import os
 
-    import jax
+    if os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") == "1":
+        return  # env kill switch wins (benchmarks measuring cold compiles)
+    if not parallel.persistent_compilation_cache:
+        settings: tuple = (False, None)
 
-    try:
-        if os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") == "1":
-            return  # env kill switch wins (benchmarks measuring cold compiles)
-        if not parallel.persistent_compilation_cache:
-            jax.config.update("jax_compilation_cache_dir", None)
-            return
+        def apply() -> None:
+            import jax
+
+            try:
+                jax.config.update("jax_compilation_cache_dir", None)
+            except Exception:  # pragma: no cover — optimization only
+                pass
+
+    else:
         cache_dir = (
             parallel.compilation_cache_dir
             or os.environ.get("KAMINPAR_TPU_CACHE_DIR")
@@ -458,41 +498,89 @@ def configure_compilation_cache(parallel: ParallelContext) -> None:
                 os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla"
             )
         )
-        os.makedirs(cache_dir, exist_ok=True)
-        # Tuning knobs are optional — their absence must not disable the
-        # cache itself.
-        for knob, val in (
-            ("jax_persistent_cache_min_compile_time_secs", 0.5),
-            ("jax_persistent_cache_min_entry_size_bytes", 0),
-        ):
+        settings = (True, cache_dir)
+
+        def apply() -> None:
+            import jax
+
             try:
-                jax.config.update(knob, val)
-            except Exception:
+                os.makedirs(cache_dir, exist_ok=True)
+                # Tuning knobs are optional — their absence must not disable
+                # the cache itself.
+                for knob, val in (
+                    ("jax_persistent_cache_min_compile_time_secs", 0.5),
+                    ("jax_persistent_cache_min_entry_size_bytes", 0),
+                ):
+                    try:
+                        jax.config.update(knob, val)
+                    except Exception:
+                        pass
+                # The AOT-executable guard is load-bearing (CPU serializer
+                # crashes, see kaminpar_tpu/__init__.py) and must be live
+                # BEFORE the cache dir: if it is missing, the except below
+                # keeps the cache off.
+                jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+            except Exception:  # pragma: no cover — optimization only
                 pass
-        # The AOT-executable guard is load-bearing (CPU serializer crashes,
-        # see kaminpar_tpu/__init__.py) and must be live BEFORE the cache
-        # dir: if it is missing, the except below keeps the cache off.
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception:  # pragma: no cover — the cache is an optimization only
-        pass
+
+    _configure_once("compilation_cache", settings, apply)
 
 
 def configure_layout_build(parallel: ParallelContext) -> None:
     """Apply the context's layout-build backend to graph construction
     (graph/csr.py global; the KAMINPAR_TPU_LAYOUT_BUILD env var overrides).
-    Safe to call repeatedly; later calls win (the facade calls it per
-    KaMinPar(), the configure_compilation_cache pattern)."""
+    First-wins like :func:`configure_compilation_cache`; per-graph behavior
+    stays correct regardless because the facade pins its mode on each graph
+    (``CSRGraph._layout_mode``).  Direct ``set_layout_build_mode`` calls
+    (tests, tools) still take effect unconditionally."""
     from .graph.csr import set_layout_build_mode
 
-    set_layout_build_mode(parallel.device_layout_build)
+    mode = parallel.device_layout_build
+    _configure_once("layout_build", (mode,), lambda: set_layout_build_mode(mode))
 
 
 def configure_sync_timers(parallel: ParallelContext) -> None:
-    """Apply the context's sync-timers profiling switch (utils/timer.py)."""
+    """Apply the context's sync-timers profiling switch (utils/timer.py).
+    First-wins; ``timer.set_sync_mode`` remains the unconditional override."""
     from .utils import timer
 
-    timer.set_sync_mode(parallel.sync_timers)
+    on = bool(parallel.sync_timers)
+    _configure_once("sync_timers", (on,), lambda: timer.set_sync_mode(on))
+
+
+@dataclass
+class ServeContext:
+    """Knobs of the partition-serving runtime (:mod:`kaminpar_tpu.serve`).
+
+    A :class:`~kaminpar_tpu.serve.PartitionEngine` owns one long-lived device
+    context: it precompiles the executable set over the ``warm_ladder`` x
+    ``warm_ks`` grid at startup, keeps workspaces device-resident between
+    requests, and serves a bounded async queue with admission control,
+    deadlines, and micro-batching of same-shape-cell requests."""
+
+    # Node-count rungs to warm at startup (powers of two; each rung warms
+    # its whole padded bucket chain by running one synthetic partition).
+    warm_ladder: tuple = (256, 1024)
+    # k values to warm per rung.
+    warm_ks: tuple = (8,)
+    # Edge factor of the synthetic (RMAT) warmup graphs.
+    warm_edge_factor: int = 8
+    # Max requests fused into one micro-batch (same (n-bucket, m-bucket, k)
+    # shape cell only; see serve/batching.py).
+    max_batch: int = 8
+    # Admission bound of the request queue; submits beyond it are rejected
+    # with a retry-after estimate (backpressure) instead of queueing without
+    # limit.
+    queue_bound: int = 64
+    # After the first request of a batch arrives, wait up to this long for
+    # more same-cell requests before dispatching the batch.
+    batch_window_ms: float = 2.0
+    # Default per-request deadline; 0 disables (requests wait forever).
+    default_deadline_ms: float = 0.0
+    # Graceful-shutdown budget: how long shutdown(drain=True) waits for the
+    # queue to empty before giving up on the dispatcher thread.
+    drain_timeout_s: float = 60.0
 
 
 @dataclass
@@ -533,6 +621,7 @@ class Context:
     compression: GraphCompressionContext = field(
         default_factory=GraphCompressionContext
     )
+    serve: ServeContext = field(default_factory=ServeContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
     # v-cycle mode: intermediate k values partitioned before the final k

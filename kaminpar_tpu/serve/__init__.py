@@ -1,0 +1,50 @@
+"""kaminpar_tpu.serve — the partition-serving runtime (ISSUE 3).
+
+A :class:`PartitionEngine` owns one long-lived warm device context:
+ladder/k-range warmup at startup, a bounded async request queue with
+admission control, deadlines, and backpressure, micro-batching of
+same-shape-cell requests with single-dispatch batched metrics, and a
+structured stats snapshot.  ``python -m kaminpar_tpu.serve`` is the CLI
+entry (serve files, run the synthetic demo load, or warm up and exit).
+"""
+
+from .batching import (
+    PackedBatch,
+    ShapeCell,
+    batched_metrics,
+    form_batches,
+    pack_graphs,
+    shape_cell,
+    unpack_partition,
+)
+from .engine import PartitionEngine, ServeFuture, ServeRequest, ServeResult
+from .errors import (
+    DeadlineExceededError,
+    EngineStoppedError,
+    QueueFullError,
+    RequestCancelledError,
+    ServeError,
+)
+from .queue import BoundedServeQueue
+from .stats import ServeStats
+
+__all__ = [
+    "BoundedServeQueue",
+    "DeadlineExceededError",
+    "EngineStoppedError",
+    "PackedBatch",
+    "PartitionEngine",
+    "QueueFullError",
+    "RequestCancelledError",
+    "ServeError",
+    "ServeFuture",
+    "ServeRequest",
+    "ServeResult",
+    "ServeStats",
+    "ShapeCell",
+    "batched_metrics",
+    "form_batches",
+    "pack_graphs",
+    "shape_cell",
+    "unpack_partition",
+]

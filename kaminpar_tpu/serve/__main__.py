@@ -1,0 +1,124 @@
+"""``python -m kaminpar_tpu.serve`` — the serving CLI.
+
+Three modes:
+
+* ``--warmup-only``: start the engine (ladder precompile), print the
+  per-cell warmup report + stats snapshot as JSON, exit.  The same report
+  is available offline via ``python -m kaminpar_tpu.tools warmup``.
+* graph files as positionals: serve each file through the warm engine
+  (one request per file), optionally writing ``<graph>.part`` outputs.
+* ``--demo N`` (default when no graphs are given): run a synthetic
+  burst workload of N RMAT requests across the warm ladder and print the
+  stats snapshot — the quickest way to see batching/queueing behave.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _int_tuple(text: str) -> tuple:
+    return tuple(int(s) for s in text.split(",") if s.strip())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m kaminpar_tpu.serve",
+        description="Partition-serving runtime: warm engine, bucket-batched "
+        "dispatch, bounded async queue.",
+    )
+    p.add_argument("graphs", nargs="*", help="graph files to serve (METIS/ParHIP)")
+    p.add_argument("-P", "--preset", default="serve")
+    p.add_argument("-k", type=int, default=8, help="blocks per request")
+    p.add_argument("-e", "--epsilon", type=float, default=0.03)
+    p.add_argument("--ladder", type=_int_tuple, default=None,
+                   help="warmup node-count rungs, e.g. 256,1024")
+    p.add_argument("--warm-ks", type=_int_tuple, default=None,
+                   help="warmup k values, e.g. 4,8")
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--queue-bound", type=int, default=None)
+    p.add_argument("--batch-window-ms", type=float, default=None)
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline (0 = none)")
+    p.add_argument("--warmup-only", action="store_true")
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--demo", type=int, default=16, metavar="N",
+                   help="synthetic burst requests when no graphs are given")
+    p.add_argument("--demo-edge-factor", type=int, default=8)
+    p.add_argument("-o", "--output", action="store_true",
+                   help="write <graph>.part next to each served graph file")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..utils.platform import prefer_working_backend
+
+    prefer_working_backend()
+    from ..presets import create_context_by_preset_name
+    from .engine import PartitionEngine
+
+    ctx = create_context_by_preset_name(args.preset)
+    overrides = {}
+    if args.ladder is not None:
+        overrides["warm_ladder"] = args.ladder
+    if args.warm_ks is not None:
+        overrides["warm_ks"] = args.warm_ks
+    for flag, knob in (("max_batch", "max_batch"),
+                       ("queue_bound", "queue_bound"),
+                       ("batch_window_ms", "batch_window_ms"),
+                       ("deadline_ms", "default_deadline_ms")):
+        val = getattr(args, flag)
+        if val is not None:
+            overrides[knob] = val
+    engine = PartitionEngine(ctx, **overrides)
+    engine.start(warmup=not args.no_warmup)
+    try:
+        if args.warmup_only:
+            print(json.dumps({"warmup": engine.warmup_report,
+                              "stats": engine.stats()}, default=str))
+            return 0
+        if args.graphs:
+            from .. import io as kio
+
+            futures = []
+            for path in args.graphs:
+                g = kio.read_graph(path)
+                futures.append((path, engine.submit(g, args.k, args.epsilon)))
+            for path, fut in futures:
+                res = fut.result()
+                print(f"RESULT graph={path} k={args.k} cut={res.cut} "
+                      f"feasible={int(res.feasible)} "
+                      f"batch={res.batch_size} warm={int(res.warm_hit)} "
+                      f"wait_ms={res.queue_wait_s * 1e3:.1f} "
+                      f"exec_ms={res.execute_s * 1e3:.1f}")
+                if args.output:
+                    kio.write_partition(path + ".part", res.partition)
+        else:
+            from ..graph.generators import rmat_graph
+
+            ladder = engine.serve.warm_ladder or (256,)
+            t0 = time.perf_counter()
+            futures = []
+            for i in range(args.demo):
+                n = ladder[i % len(ladder)]
+                scale = max(2, (int(n) - 1).bit_length())
+                g = rmat_graph(scale, edge_factor=args.demo_edge_factor,
+                               seed=100 + i)
+                futures.append(engine.submit(g, args.k, args.epsilon))
+            for fut in futures:
+                fut.result()
+            wall = time.perf_counter() - t0
+            print(f"demo: {args.demo} requests in {wall:.2f}s "
+                  f"({args.demo / wall:.2f} graphs/s)")
+        print(json.dumps(engine.stats(), default=str))
+        return 0
+    finally:
+        engine.shutdown(drain=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

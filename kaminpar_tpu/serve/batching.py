@@ -1,0 +1,187 @@
+"""Bucket-batched multi-graph packing for the serving runtime.
+
+Requests landing in the same shape cell — ``(node-bucket, edge-bucket, k)``
+on the sqrt(2) geometric ladder of :func:`utils.intmath.next_shape_bucket`,
+the same ladder every ``CSRGraph.padded()`` view compiles against — are
+micro-batched.  The batch's graphs are packed as *disjoint components* into
+one union CSR buffer (host-side concatenation with node-id offsets; the
+components never share an edge, so per-graph structure is preserved
+exactly), and per-graph quality metrics for the whole batch are computed in
+a **single dispatch** over the packed buffer via graph-id segment
+reductions (:func:`batched_metrics`), with one batched readback for all of
+them — the one-pull discipline of PR 2.
+
+The partitions themselves are produced per graph by the engine's warm
+pipeline (serve/engine.py) so they stay bit-identical to sequential
+``KaMinPar.compute_partition`` runs — the identity discipline PR 1/2
+established for kernels; tests/test_serve.py asserts it — and are then
+validated/unpacked against the packed buffer here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.csr import CSRGraph, _next_bucket
+
+
+class ShapeCell(NamedTuple):
+    """Batching key: two padded-shape rungs plus the block count."""
+
+    n_bucket: int
+    m_bucket: int
+    k: int
+
+
+def shape_cell(graph, k: int) -> ShapeCell:
+    """The (node-bucket, edge-bucket, k) cell a request lands in.  Uses the
+    same geometric ladder (and the same minimum rung) as
+    ``CSRGraph.padded()``, so one cell == one set of top-level compile
+    shapes."""
+    return ShapeCell(_next_bucket(graph.n), _next_bucket(graph.m), int(k))
+
+
+class PackedBatch(NamedTuple):
+    """Disjoint union of a batch's graphs plus unpack metadata.
+
+    ``node_offsets``/``edge_offsets`` are (b+1,) prefix sums; graph ``i``
+    owns nodes ``[node_offsets[i], node_offsets[i+1])`` of the union.
+    ``node_gid``/``edge_gid`` map every union slot back to its graph."""
+
+    union: CSRGraph
+    node_offsets: np.ndarray
+    edge_offsets: np.ndarray
+    node_gid: np.ndarray
+    edge_gid: np.ndarray
+
+    @property
+    def num_graphs(self) -> int:
+        return len(self.node_offsets) - 1
+
+
+def pack_graphs(graphs: Sequence[CSRGraph]) -> PackedBatch:
+    """Pack graphs as disjoint components into one padded-buffer-ready CSR.
+
+    Host-side (batch formation is orchestration): concatenates the CSR
+    arrays with node-id offsets.  The union is a structurally valid graph —
+    ``graph.csr.validate`` accepts it — whose padded view lands on the
+    bucket ladder like any other graph."""
+    if not graphs:
+        raise ValueError("cannot pack an empty batch")
+    use_64 = any(np.asarray(g.row_ptr).dtype == np.int64 for g in graphs)
+    idt = np.int64 if use_64 else np.int32
+    n_off = np.zeros(len(graphs) + 1, dtype=np.int64)
+    m_off = np.zeros(len(graphs) + 1, dtype=np.int64)
+    np.cumsum([g.n for g in graphs], out=n_off[1:])
+    np.cumsum([g.m for g in graphs], out=m_off[1:])
+    row_ptr = np.zeros(int(n_off[-1]) + 1, dtype=idt)
+    col_idx = np.empty(int(m_off[-1]), dtype=idt)
+    node_w = np.empty(int(n_off[-1]), dtype=idt)
+    edge_w = np.empty(int(m_off[-1]), dtype=idt)
+    node_gid = np.empty(int(n_off[-1]), dtype=np.int32)
+    edge_gid = np.empty(int(m_off[-1]), dtype=np.int32)
+    for i, g in enumerate(graphs):
+        ns, ne = int(n_off[i]), int(n_off[i + 1])
+        ms, me = int(m_off[i]), int(m_off[i + 1])
+        row_ptr[ns + 1 : ne + 1] = np.asarray(g.row_ptr)[1:] + ms
+        col_idx[ms:me] = np.asarray(g.col_idx) + ns
+        node_w[ns:ne] = np.asarray(g.node_w)
+        edge_w[ms:me] = np.asarray(g.edge_w)
+        node_gid[ns:ne] = i
+        edge_gid[ms:me] = i
+    return PackedBatch(
+        CSRGraph(row_ptr, col_idx, node_w, edge_w),
+        n_off, m_off, node_gid, edge_gid,
+    )
+
+
+def unpack_partition(labels, node_offsets: np.ndarray) -> List[np.ndarray]:
+    """Split a union-node-space label array back into per-graph arrays."""
+    labels = np.asarray(labels)
+    return [
+        labels[int(node_offsets[i]) : int(node_offsets[i + 1])]
+        for i in range(len(node_offsets) - 1)
+    ]
+
+
+def form_batches(requests: Sequence, max_batch: int) -> List[list]:
+    """Group requests into same-cell batches of at most ``max_batch``,
+    FIFO-fair: each batch is seeded by the oldest unbatched request and
+    collects later same-cell requests in arrival order.  Items must carry a
+    ``.cell`` attribute (``ServeRequest`` does)."""
+    batches: List[list] = []
+    remaining = list(requests)
+    while remaining:
+        cell = remaining[0].cell
+        take = [r for r in remaining if r.cell == cell][: max(1, int(max_batch))]
+        taken = set(map(id, take))
+        remaining = [r for r in remaining if id(r) not in taken]
+        batches.append(take)
+    return batches
+
+
+@partial(jax.jit, static_argnames=("num_graphs", "k"))
+def _packed_metrics(edge_u, col_idx, edge_w, labels, edge_gid, node_w,
+                    node_gid, num_graphs: int, k: int):
+    """Per-graph edge cuts + block weights of a packed batch, one dispatch.
+
+    Graph-id segment reductions over the union buffer; pad slots are inert
+    (weight 0) exactly as in graph/metrics.py.  Returns one flat int64
+    array ``[cut_0..cut_{b-1}, bw_0_0..bw_{b-1}_{k-1}]`` so the caller
+    needs a single batched readback for the whole batch."""
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "serve_packed_metrics", (edge_u, labels), (num_graphs, k)
+    )
+    cut = labels[edge_u] != labels[col_idx]
+    cuts = (
+        jax.ops.segment_sum(
+            jnp.where(cut, edge_w, 0), edge_gid, num_segments=num_graphs
+        )
+        // 2
+    )
+    seg = node_gid * k + labels.astype(node_gid.dtype)
+    bw = jax.ops.segment_sum(
+        node_w.astype(edge_w.dtype), seg, num_segments=num_graphs * k
+    )
+    return jnp.concatenate([cuts, bw])
+
+
+def batched_metrics(
+    packed: PackedBatch,
+    parts: Sequence[np.ndarray],
+    k: int,
+    pad_to: Optional[int] = None,
+):
+    """(cuts (b,), block_weights (b, k)) for every graph of the batch —
+    single dispatch over the packed union buffer, single counted readback
+    (utils/sync_stats phase ``serve_batch_metrics``).
+
+    ``pad_to`` buckets the static graph-count at the engine's max batch:
+    the trailing segments simply sum nothing, so the kernel compiles once
+    per (union bucket, k, max_batch) instead of once per occupancy level
+    — the same specialization-count discipline as the shape ladder."""
+    from ..utils import sync_stats
+
+    b = packed.num_graphs
+    nb = max(b, int(pad_to or 0))
+    pv = packed.union.padded()
+    labels = np.zeros(pv.n_pad, dtype=np.int32)
+    labels[: pv.n] = np.concatenate([np.asarray(p) for p in parts])
+    egid = np.zeros(pv.m_pad, dtype=np.int32)
+    egid[: pv.m] = packed.edge_gid
+    ngid = np.zeros(pv.n_pad, dtype=np.int32)
+    ngid[: pv.n] = packed.node_gid
+    flat = _packed_metrics(
+        pv.edge_u, pv.col_idx, pv.edge_w, jnp.asarray(labels),
+        jnp.asarray(egid), pv.node_w, jnp.asarray(ngid),
+        num_graphs=nb, k=int(k),
+    )
+    flat = sync_stats.pull(flat, phase="serve_batch_metrics")
+    return flat[:b], flat[nb:].reshape(nb, int(k))[:b]

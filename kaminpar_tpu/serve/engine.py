@@ -1,0 +1,501 @@
+"""The partition-serving engine: one long-lived warm device context.
+
+``KaMinPar.compute_partition`` is a cold, single-graph, synchronous call —
+the shape ladder, compile cache, and device workspaces are rebuilt per call
+and idle between calls.  :class:`PartitionEngine` turns that machinery into
+a persistent runtime, the standard inference-stack shape:
+
+* **Warmup** — at startup the engine precompiles/warms the executable set
+  over a configured shape-bucket ladder and k-range (one synthetic
+  partition per (rung, k); every padded bucket the multilevel hierarchy
+  visits below that rung gets traced and lands in the persistent XLA
+  cache).  Per-cell warm cost is recorded from ``utils/compile_stats`` and
+  exposed via :attr:`warmup_report` (the ``tools warmup`` subcommand prints
+  it).
+* **Bounded async queue** — ``submit`` performs admission control against
+  a bounded queue and returns a :class:`ServeFuture`; a full queue rejects
+  with a retry-after estimate (backpressure), per-request deadlines expire
+  queued work, and ``shutdown(drain=True)`` drains gracefully.
+* **Micro-batching** — requests in the same (node-bucket, edge-bucket, k)
+  shape cell are dispatched as one batch: partitions are produced by the
+  engine's warm pipeline per graph (bit-identical to sequential facade
+  runs — asserted in tests/test_serve.py), then the whole batch's quality
+  metrics are computed in a single dispatch over the packed disjoint-union
+  buffer with one batched readback (serve/batching.py).
+
+A synchronous convenience wrapper (:meth:`partition`) lets the facade
+delegate to a warm engine (``KaMinPar(ctx, engine=...)``).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..context import Context, ServeContext
+from .batching import ShapeCell, batched_metrics, pack_graphs, shape_cell
+from .errors import (
+    DeadlineExceededError,
+    EngineStoppedError,
+    QueueFullError,
+    RequestCancelledError,
+    ServeError,
+)
+from .queue import BoundedServeQueue
+from .stats import ServeStats
+
+
+@dataclass
+class ServeResult:
+    """What a fulfilled request resolves to."""
+
+    partition: np.ndarray
+    cut: int
+    feasible: bool
+    batch_size: int
+    queue_wait_s: float
+    execute_s: float
+    warm_hit: bool
+    request_id: int
+
+
+class ServeFuture:
+    """Completion handle for a submitted request."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._ev = threading.Event()
+        self._result: Optional[ServeResult] = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._started = False
+        self._lock = threading.Lock()
+
+    def cancel(self) -> bool:
+        """Cancel if execution has not started; returns success.  A running
+        XLA computation cannot be interrupted — late cancels return False."""
+        with self._lock:
+            if self._started or self._ev.is_set():
+                return False
+            self._cancelled = True
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+    def _mark_started(self) -> bool:
+        """Engine-side: claim the request for execution; False if it was
+        cancelled first."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._started = True
+            return True
+
+    def _resolve(self, result: ServeResult) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        """Block for the result; raises the request's error (deadline,
+        cancellation, engine-stopped, or the pipeline's own exception)."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+@dataclass
+class ServeRequest:
+    """One queued unit of work (internal; carries the batching cell)."""
+
+    id: int
+    graph: object
+    k: int
+    epsilon: float
+    cell: ShapeCell
+    future: ServeFuture
+    enqueue_t: float
+    deadline_t: Optional[float]  # absolute monotonic; None = no deadline
+    warm_hit: bool
+    max_block_weights: Optional[Sequence[int]] = None
+    min_epsilon: float = 0.0
+    min_block_weights: Optional[Sequence[int]] = None
+    # Filled during execution:
+    partition: Optional[np.ndarray] = None
+    caps: Optional[np.ndarray] = None
+    execute_s: float = 0.0
+    queue_wait_s: float = 0.0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now > self.deadline_t
+
+
+class PartitionEngine:
+    """Persistent partition-serving runtime over one warm device context.
+
+    Usage::
+
+        from kaminpar_tpu.serve import PartitionEngine
+        with PartitionEngine("serve") as engine:        # starts + warms
+            fut = engine.submit(graph, k=8)             # async
+            part = fut.result().partition
+            part2 = engine.partition(graph2, k=8)       # sync wrapper
+
+    Thread model: ``submit``/``partition`` are called from any thread; a
+    single dispatcher thread owns the pipeline (batch formation, the warm
+    facade, the packed metrics dispatch), so device work is never issued
+    concurrently and per-request RNG streams stay deterministic.
+    """
+
+    def __init__(
+        self,
+        ctx: Union[Context, str, None] = None,
+        **serve_overrides,
+    ):
+        from ..presets import create_context_by_preset_name
+
+        if ctx is None:
+            ctx = create_context_by_preset_name("serve")
+        elif isinstance(ctx, str):
+            ctx = create_context_by_preset_name(ctx)
+        else:
+            # The engine owns its tree: a caller mutating the context they
+            # passed must not skew results of in-flight requests.
+            ctx = copy.deepcopy(ctx)
+        self.ctx = ctx
+        if serve_overrides:
+            ctx.serve = replace(ctx.serve, **serve_overrides)
+        self.serve: ServeContext = ctx.serve
+        self._queue = BoundedServeQueue(self.serve.queue_bound)
+        self.stats_ = ServeStats()
+        self._warm_nk: set = set()     # (n_bucket, k) — warm-hit accounting
+        self._warm_cells: set = set()  # exact (n_bucket, m_bucket, k) cells
+        self.warmup_report: List[dict] = []
+        self._ids = itertools.count(1)
+        self._solver = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._gate = threading.Event()  # pause/resume; set == dispatching
+        self._gate.set()
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "PartitionEngine":
+        """Initialize the warm context (idempotent).  ``warmup=True`` runs
+        the ladder precompile before the first request is accepted."""
+        with self._lock:
+            if self._running:
+                return self
+            if self._queue.closed:
+                # Restart after shutdown: the old queue was closed to drain
+                # the dispatcher, so a fresh one is needed (warm state —
+                # solver caches, warm cells, stats — carries over).
+                self._queue = BoundedServeQueue(self.serve.queue_bound)
+            from ..kaminpar import KaMinPar
+
+            # The internal facade applies configure_* once; a second engine
+            # with conflicting global settings warns instead of clobbering
+            # (context._configure_once).
+            if self._solver is None:
+                self._solver = KaMinPar(copy.deepcopy(self.ctx))
+            if warmup:
+                self._warmup()
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="kaminpar-serve-dispatch", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _warmup(self) -> None:
+        """Trace/compile the executable set over warm_ladder x warm_ks by
+        running one synthetic RMAT partition per cell; every padded bucket
+        the hierarchy visits below each rung gets warmed too.  Per-cell
+        wall + compile/trace seconds come from utils/compile_stats."""
+        from ..graph.generators import rmat_graph
+        from ..utils import compile_stats
+
+        compile_stats.enable_compile_time_tracking()
+        for n in self.serve.warm_ladder:
+            scale = max(2, int(np.ceil(np.log2(max(int(n), 4)))))
+            for k in self.serve.warm_ks:
+                if k > (1 << scale):
+                    continue
+                g = rmat_graph(
+                    scale, edge_factor=self.serve.warm_edge_factor, seed=1
+                )
+                cell = shape_cell(g, k)
+                before = compile_stats.compile_time_snapshot()
+                t0 = time.perf_counter()
+                self._solver.set_graph(g)
+                self._solver.compute_partition(int(k), 0.03)
+                wall = time.perf_counter() - t0
+                after = compile_stats.compile_time_snapshot()
+                self.warmup_report.append({
+                    "n": 1 << scale,
+                    "k": int(k),
+                    "n_bucket": cell.n_bucket,
+                    "m_bucket": cell.m_bucket,
+                    "wall_s": round(wall, 3),
+                    "backend_compile_s": round(
+                        after["backend_compile_s"] - before["backend_compile_s"], 3
+                    ),
+                    "trace_s": round(after["trace_s"] - before["trace_s"], 3),
+                })
+                self._note_warm(cell)
+
+    def _note_warm(self, cell: ShapeCell) -> None:
+        self._warm_cells.add(cell)
+        self._warm_nk.add((cell.n_bucket, cell.k))
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def pause(self) -> None:
+        """Hold the dispatcher before its next batch (maintenance window;
+        queued work waits, admission stays open up to the queue bound)."""
+        self._gate.clear()
+
+    def resume(self) -> None:
+        self._gate.set()
+
+    def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None) -> None:
+        """Stop the engine.  ``drain=True`` serves everything already
+        queued first; ``drain=False`` rejects queued work with
+        :class:`EngineStoppedError`.  Idempotent."""
+        with self._lock:
+            if not self._running:
+                return
+            self._queue.close()
+            if not drain:
+                for req in self._queue.drain_items():
+                    self.stats_.bump("cancelled")
+                    req.future._reject(
+                        EngineStoppedError("engine shut down before execution")
+                    )
+            self._gate.set()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout_s or self.serve.drain_timeout_s)
+        with self._lock:
+            self._running = False
+
+    def __enter__(self) -> "PartitionEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- request path ------------------------------------------------------
+
+    def submit(
+        self,
+        graph,
+        k: int,
+        epsilon: float = 0.03,
+        *,
+        deadline_ms: Optional[float] = None,
+        max_block_weights: Optional[Sequence[int]] = None,
+        min_epsilon: float = 0.0,
+        min_block_weights: Optional[Sequence[int]] = None,
+    ) -> ServeFuture:
+        """Enqueue one partition request; returns a :class:`ServeFuture`.
+
+        Raises :class:`EngineStoppedError` when not running and
+        :class:`QueueFullError` (with ``retry_after_s``) when admission
+        control rejects the request."""
+        if not self._running:
+            raise EngineStoppedError("engine not started (call start())")
+        self.stats_.bump("submitted")
+        cell = shape_cell(graph, k)
+        warm = (cell.n_bucket, int(k)) in self._warm_nk
+        self.stats_.record_warm(warm)
+        if deadline_ms is None:
+            deadline_ms = self.serve.default_deadline_ms
+        now = time.monotonic()
+        req = ServeRequest(
+            id=next(self._ids),
+            graph=graph,
+            k=int(k),
+            epsilon=float(epsilon),
+            cell=cell,
+            future=ServeFuture(0),
+            enqueue_t=now,
+            deadline_t=now + deadline_ms / 1e3 if deadline_ms else None,
+            warm_hit=warm,
+            max_block_weights=max_block_weights,
+            min_epsilon=float(min_epsilon),
+            min_block_weights=min_block_weights,
+        )
+        req.future.request_id = req.id
+        try:
+            self._queue.put(req)
+        except QueueFullError:
+            self.stats_.bump("rejected_full")
+            raise QueueFullError(
+                self.stats_.retry_after_estimate(
+                    len(self._queue), self.serve.max_batch
+                )
+            ) from None
+        self.stats_.bump("admitted")
+        return req.future
+
+    def partition(
+        self,
+        graph,
+        k: int,
+        epsilon: float = 0.03,
+        *,
+        deadline_ms: Optional[float] = None,
+        max_block_weights: Optional[Sequence[int]] = None,
+        min_epsilon: float = 0.0,
+        min_block_weights: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
+        """Synchronous convenience wrapper: submit + wait, returning the
+        (n,) block array — the facade delegates here when constructed with
+        an engine.  Auto-starts a not-yet-started engine *without* warmup
+        (call :meth:`start` yourself to pay warmup at a chosen moment)."""
+        if not self._running:
+            self.start(warmup=False)
+        fut = self.submit(
+            graph, k, epsilon,
+            deadline_ms=deadline_ms,
+            max_block_weights=max_block_weights,
+            min_epsilon=min_epsilon,
+            min_block_weights=min_block_weights,
+        )
+        return fut.result().partition
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            self._gate.wait()
+            batch = self._queue.pop_batch(
+                self.serve.max_batch, self.serve.batch_window_ms / 1e3
+            )
+            if batch is None:
+                return  # closed + drained: graceful exit
+            try:
+                self._execute_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — a poisoned batch must
+                # not kill the dispatcher; reject its requests instead.
+                for req in batch:
+                    if not req.future.done():
+                        req.future._reject(ServeError(f"batch failed: {exc!r}"))
+                        self.stats_.record_request(
+                            time.monotonic() - req.enqueue_t, 0.0, failed=True
+                        )
+
+    def _execute_batch(self, batch: List[ServeRequest]) -> None:
+        now = time.monotonic()
+        live: List[ServeRequest] = []
+        for req in batch:
+            if req.future.cancelled:
+                self.stats_.bump("cancelled")
+                req.future._reject(RequestCancelledError(f"request {req.id}"))
+            elif req.expired(now):
+                self.stats_.bump("timed_out")
+                req.future._reject(DeadlineExceededError(
+                    f"request {req.id} expired after "
+                    f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue"
+                ))
+            elif req.future._mark_started():
+                live.append(req)
+            else:
+                self.stats_.bump("cancelled")
+                req.future._reject(RequestCancelledError(f"request {req.id}"))
+        if not live:
+            return
+        self.stats_.record_batch(len(live))
+
+        ok: List[ServeRequest] = []
+        for req in live:
+            # Queue wait runs until THIS request's execution starts, so a
+            # late batch member's wait includes in-batch serialization —
+            # reported percentiles must cover the full submit->resolve wall.
+            req.queue_wait_s = time.monotonic() - req.enqueue_t
+            t0 = time.perf_counter()
+            try:
+                # The warm facade runs the *identical* code path a cold
+                # sequential KaMinPar.compute_partition runs (including its
+                # per-call RNG reseed), so per-graph results are
+                # bit-identical to single-graph runs by construction.
+                self._solver.set_graph(req.graph)
+                req.partition = self._solver.compute_partition(
+                    req.k, req.epsilon, req.max_block_weights,
+                    req.min_epsilon, req.min_block_weights,
+                )
+                req.caps = np.asarray(
+                    self._solver.ctx.partition.max_block_weights,
+                    dtype=np.int64,
+                ).copy()
+                req.execute_s = time.perf_counter() - t0
+                ok.append(req)
+            except Exception as exc:  # noqa: BLE001 — per-request isolation
+                self.stats_.record_request(
+                    req.queue_wait_s, time.perf_counter() - t0, failed=True
+                )
+                req.future._reject(exc)
+        if not ok:
+            return
+
+        # Whole-batch quality metrics in ONE dispatch over the packed
+        # disjoint-union buffer + one batched readback (serve/batching.py).
+        t_metrics = time.perf_counter()
+        cuts, bws = batched_metrics(
+            pack_graphs([r.graph for r in ok]),
+            [r.partition for r in ok],
+            ok[0].k,
+            pad_to=self.serve.max_batch,
+        )
+        metrics_share_s = (time.perf_counter() - t_metrics) / len(ok)
+        for i, req in enumerate(ok):
+            req.execute_s += metrics_share_s
+            self._note_warm(req.cell)
+            feasible = bool(np.all(bws[i] <= req.caps))
+            self.stats_.record_request(req.queue_wait_s, req.execute_s)
+            req.future._resolve(ServeResult(
+                partition=req.partition,
+                cut=int(cuts[i]),
+                feasible=feasible,
+                batch_size=len(ok),
+                queue_wait_s=req.queue_wait_s,
+                execute_s=req.execute_s,
+                warm_hit=req.warm_hit,
+                request_id=req.id,
+            ))
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Structured snapshot: queue depth, admission/reject/timeout
+        counts, batch occupancy, warm-cache hit rate, latency percentiles,
+        plus the compile-shape and blocking-transfer censuses."""
+        snap = self.stats_.snapshot(queue_depth=len(self._queue))
+        snap["running"] = self._running
+        snap["warm_cells"] = len(self._warm_cells)
+        snap["warmup"] = list(self.warmup_report)
+        return snap

@@ -1,0 +1,43 @@
+"""Error surface of the partition-serving runtime.
+
+Mirrors the error taxonomy of standard inference-serving stacks: admission
+rejection (backpressure, carries a retry-after hint), deadline expiry,
+cancellation, and engine-stopped.  All derive from :class:`ServeError` so
+callers can catch the whole family at once.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class of every serving-runtime error."""
+
+
+class QueueFullError(ServeError):
+    """Admission control rejected the request: the bounded queue is full.
+
+    ``retry_after_s`` is the engine's estimate of when capacity frees up
+    (queue depth x smoothed per-request service time / batch width) — the
+    standard reject-with-retry-after backpressure contract."""
+
+    def __init__(self, retry_after_s: float = 0.1):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"serve queue full; retry after {self.retry_after_s:.3f}s"
+        )
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired before execution started.
+
+    A dispatched XLA computation is not interruptible, so deadlines are
+    enforced at admission and at batch formation — a request that starts
+    executing runs to completion."""
+
+
+class RequestCancelledError(ServeError):
+    """The request was cancelled (``ServeFuture.cancel``) before it ran."""
+
+
+class EngineStoppedError(ServeError):
+    """The engine is not running (never started, draining, or shut down)."""

@@ -1,0 +1,97 @@
+"""Bounded request queue with same-cell batch extraction.
+
+The admission side is strict (``put`` raises :class:`QueueFullError` when
+the bound is hit — the engine wraps it with a retry-after estimate) and the
+consumer side pops *micro-batches*: the oldest request seeds a batch and
+later requests from the same shape cell join it, up to ``max_batch``,
+optionally waiting a short batch window for stragglers.  Requests from
+other cells keep their FIFO order — extracting a batch never reorders the
+remainder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from .batching import form_batches
+from .errors import EngineStoppedError, QueueFullError
+
+
+class BoundedServeQueue:
+    """Thread-safe bounded FIFO of items carrying a ``.cell`` attribute."""
+
+    def __init__(self, bound: int):
+        if bound < 1:
+            raise ValueError("queue bound must be >= 1")
+        self.bound = int(bound)
+        self._dq: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._dq)
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def put(self, item) -> None:
+        """Admit one request; raises :class:`QueueFullError` at the bound
+        and :class:`EngineStoppedError` after :meth:`close`."""
+        with self._cv:
+            if self._closed:
+                raise EngineStoppedError("queue closed; engine is draining")
+            if len(self._dq) >= self.bound:
+                raise QueueFullError()
+            self._dq.append(item)
+            self._cv.notify_all()
+
+    def pop_batch(self, max_batch: int, window_s: float = 0.0) -> Optional[List]:
+        """Block until a request is available, then return a same-cell batch.
+
+        The head request's cell seeds the batch; if fewer than ``max_batch``
+        same-cell requests are queued, waits up to ``window_s`` for more to
+        arrive before dispatching.  Returns ``None`` exactly once the queue
+        is closed *and* drained (the graceful-shutdown termination signal).
+        """
+        max_batch = max(1, int(max_batch))
+        with self._cv:
+            while not self._dq:
+                if self._closed:
+                    return None
+                self._cv.wait()
+            cell = self._dq[0].cell
+            deadline = time.monotonic() + max(0.0, float(window_s))
+            while not self._closed:
+                if sum(1 for r in self._dq if r.cell == cell) >= max_batch:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            # One batching policy for the whole runtime: the head-seeded
+            # same-cell selection lives in batching.form_batches.
+            batch = form_batches(self._dq, max_batch)[0]
+            taken = set(map(id, batch))
+            self._dq = deque(r for r in self._dq if id(r) not in taken)
+            return batch
+
+    def close(self) -> None:
+        """Stop admissions; consumers drain the remainder then get None."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def drain_items(self) -> List:
+        """Remove and return everything still queued (non-draining
+        shutdown resolves these with :class:`EngineStoppedError`)."""
+        with self._cv:
+            items = list(self._dq)
+            self._dq.clear()
+            self._cv.notify_all()
+            return items

@@ -1,0 +1,146 @@
+"""Serving-runtime metrics: counters, occupancy, latency percentiles.
+
+The structured snapshot the engine exposes (``PartitionEngine.stats()``)
+is built on the existing observability layers — ``utils/compile_stats``
+(distinct compiled shapes + compile seconds), ``utils/sync_stats``
+(blocking-transfer census), and the timer tree's phase names — plus the
+serving-specific signals an operator needs: queue depth, admission /
+reject / timeout counts, micro-batch occupancy, warm-cache hit rate, and
+per-phase latency percentiles (queue wait, execute, total).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class LatencyReservoir:
+    """Fixed-capacity ring of samples; summarizes to p50/p90/p99/mean/max.
+
+    A ring (latest ``cap`` samples win) keeps steady-state serving numbers
+    current instead of diluting them with warmup-era outliers."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = int(cap)
+        self._buf = np.zeros(self._cap, dtype=np.float64)
+        self._next = 0
+        self._count = 0
+
+    def add(self, value: float) -> None:
+        self._buf[self._next % self._cap] = float(value)
+        self._next += 1
+        self._count = min(self._count + 1, self._cap)
+
+    def summary(self) -> Dict[str, float]:
+        if self._count == 0:
+            return {"count": 0}
+        vals = self._buf[: self._count]
+        p50, p90, p99 = np.percentile(vals, [50, 90, 99])
+        return {
+            "count": int(self._count if self._next <= self._cap else self._next),
+            "p50": round(float(p50), 3),
+            "p90": round(float(p90), 3),
+            "p99": round(float(p99), 3),
+            "mean": round(float(vals.mean()), 3),
+            "max": round(float(vals.max()), 3),
+        }
+
+
+class ServeStats:
+    """Thread-safe accumulator for the engine's serving metrics."""
+
+    _COUNTERS = (
+        "submitted", "admitted", "rejected_full", "timed_out", "cancelled",
+        "completed", "failed", "batches", "warm_hits", "warm_misses",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Zero everything (bench sweep points reset between loads)."""
+        with self._lock:
+            self._c = {name: 0 for name in self._COUNTERS}
+            self._occupancy_sum = 0
+            self._occupancy_max = 0
+            self._lat = {
+                "queue_wait_ms": LatencyReservoir(),
+                "execute_ms": LatencyReservoir(),
+                "total_ms": LatencyReservoir(),
+            }
+            # Smoothed per-request service seconds; feeds the retry-after
+            # estimate of the admission-reject path.
+            self.ema_service_s = 0.0
+
+    def bump(self, counter: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[counter] += by
+
+    def record_warm(self, hit: bool) -> None:
+        self.bump("warm_hits" if hit else "warm_misses")
+
+    def record_batch(self, occupancy: int) -> None:
+        with self._lock:
+            self._c["batches"] += 1
+            self._occupancy_sum += int(occupancy)
+            self._occupancy_max = max(self._occupancy_max, int(occupancy))
+
+    def record_request(
+        self, queue_wait_s: float, execute_s: float, failed: bool = False
+    ) -> None:
+        with self._lock:
+            self._c["failed" if failed else "completed"] += 1
+            self._lat["queue_wait_ms"].add(queue_wait_s * 1e3)
+            self._lat["execute_ms"].add(execute_s * 1e3)
+            self._lat["total_ms"].add((queue_wait_s + execute_s) * 1e3)
+            alpha = 0.2
+            self.ema_service_s = (
+                execute_s if self.ema_service_s == 0.0
+                else (1 - alpha) * self.ema_service_s + alpha * execute_s
+            )
+
+    def retry_after_estimate(self, queue_depth: int, max_batch: int) -> float:
+        """Backpressure hint: depth x smoothed service time / batch width,
+        floored so callers never busy-spin on a zero."""
+        with self._lock:
+            per = self.ema_service_s or 0.1
+        return max(0.05, queue_depth * per / max(1, max_batch))
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._c[name]
+
+    def snapshot(self, queue_depth: Optional[int] = None) -> dict:
+        """Structured stats record (every field documented in the README
+        "Serving" section)."""
+        from ..utils import compile_stats, sync_stats
+
+        with self._lock:
+            counts = dict(self._c)
+            batches = counts["batches"]
+            out = {
+                **counts,
+                "batch_occupancy_mean": round(
+                    self._occupancy_sum / batches, 3
+                ) if batches else 0.0,
+                "batch_occupancy_max": self._occupancy_max,
+                "warm_hit_rate": round(
+                    counts["warm_hits"]
+                    / max(1, counts["warm_hits"] + counts["warm_misses"]),
+                    4,
+                ),
+                "latency_ms": {k: v.summary() for k, v in self._lat.items()},
+                "ema_service_s": round(self.ema_service_s, 4),
+            }
+        if queue_depth is not None:
+            out["queue_depth"] = int(queue_depth)
+        out["compiled_shape_count"] = compile_stats.snapshot()
+        out["compile"] = compile_stats.compile_time_snapshot()
+        sync_snap = sync_stats.snapshot()
+        out["host_sync_count"] = sync_snap["count"]
+        out["host_sync_bytes"] = sync_snap["bytes"]
+        return out

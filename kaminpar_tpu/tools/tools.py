@@ -131,10 +131,51 @@ def compression(argv) -> int:
     return 0
 
 
+def warmup(argv) -> int:
+    """Precompile the serving ladder and print per-bucket compile seconds
+    (ISSUE 3 satellite; no reference counterpart — C++ compiles AOT).  The
+    same warmup a ``PartitionEngine.start()`` performs, run offline so an
+    operator can pay the cold-compile tax before pointing traffic at the
+    process (the persistent XLA cache keeps it paid across restarts)."""
+    p = argparse.ArgumentParser(prog="warmup")
+    p.add_argument("--ladder", default="256,1024",
+                   help="comma-separated node-count rungs to warm")
+    p.add_argument("--ks", default="8", help="comma-separated k values")
+    p.add_argument("-P", "--preset", default="serve")
+    p.add_argument("--edge-factor", type=int, default=8)
+    args = p.parse_args(argv)
+    from ..serve.engine import PartitionEngine
+    from ..utils import compile_stats
+
+    engine = PartitionEngine(
+        args.preset,
+        warm_ladder=tuple(int(s) for s in args.ladder.split(",") if s.strip()),
+        warm_ks=tuple(int(s) for s in args.ks.split(",") if s.strip()),
+        warm_edge_factor=args.edge_factor,
+    )
+    engine.start(warmup=True)
+    try:
+        total_wall = 0.0
+        print(f"warmup ({args.preset} preset):")
+        for row in engine.warmup_report:
+            total_wall += row["wall_s"]
+            print(f"  cell n_bucket={row['n_bucket']} m_bucket={row['m_bucket']} "
+                  f"k={row['k']}: {row['wall_s']:.2f} s "
+                  f"(compile {row['backend_compile_s']:.2f} s, "
+                  f"trace {row['trace_s']:.2f} s)")
+        snap = compile_stats.snapshot()
+        print(f"  total: {total_wall:.2f} s over {len(engine.warmup_report)} "
+              f"cells, {snap.get('total', 0)} distinct kernel specializations")
+    finally:
+        engine.shutdown(drain=False)
+    return 0
+
+
 REGISTRY = {
     "graph-properties": graph_properties,
     "partition-properties": partition_properties,
     "connected-components": connected_components,
     "rearrange": rearrange,
     "compression": compression,
+    "warmup": warmup,
 }

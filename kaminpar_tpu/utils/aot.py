@@ -172,6 +172,29 @@ def _shm_suite(results, failures, platforms, *, use_64bit: bool = False):
     )
 
 
+def _serve_suite(results, failures, platforms):
+    """The serving runtime's batch kernels (serve/batching.py): packed
+    disjoint-union metrics over two graphs in one cell.  Warmup on silicon
+    must not be the first place these meet the TPU lowering rules."""
+    from ..graph import generators
+    from ..serve.batching import _packed_metrics, pack_graphs
+
+    graphs = [generators.rmat_graph(6, 4, seed=s) for s in (1, 2)]
+    packed = pack_graphs(graphs)
+    pv = packed.union.padded()
+    b, k = packed.num_graphs, 8
+    labels = jnp.zeros(pv.n_pad, dtype=jnp.int32)
+    egid = jnp.zeros(pv.m_pad, dtype=jnp.int32)
+    egid = egid.at[: pv.m].set(jnp.asarray(packed.edge_gid))
+    ngid = jnp.zeros(pv.n_pad, dtype=jnp.int32)
+    ngid = ngid.at[: pv.n].set(jnp.asarray(packed.node_gid))
+    _export_one(
+        results, failures, "serve_packed_metrics", _packed_metrics,
+        pv.edge_u, pv.col_idx, pv.edge_w, labels, egid, pv.node_w, ngid,
+        num_graphs=b, k=k, platforms=platforms,
+    )
+
+
 def _dist_suite(results, failures, platforms, mesh):
     from ..dist import distribute_graph
     from ..dist.balancer import (
@@ -270,14 +293,23 @@ def _dist_suite(results, failures, platforms, mesh):
     )
 
 
+def suite_total_bytes(sizes: Dict[str, int]) -> int:
+    """Cumulative serialized StableHLO size of an exported suite — the
+    number the serve-warmup artifact budget tracks (a sudden jump means a
+    kernel family forked a new specialization)."""
+    return sum(sizes.values())
+
+
 def export_kernel_suite(
     platforms: Iterable[str] = ("tpu",),
     *,
     include_dist: bool = True,
     include_x64: bool = True,
+    include_serve: bool = True,
     mesh=None,
 ) -> Dict[str, int]:
-    """Export every kernel for the target platform(s); returns name -> bytes.
+    """Export every kernel for the target platform(s); returns name -> bytes
+    (cumulative size via :func:`suite_total_bytes`).
 
     Raises :class:`AotExportError` listing every kernel that failed to lower.
     ``mesh`` defaults to an 8-device mesh over the available devices (tests
@@ -289,6 +321,10 @@ def export_kernel_suite(
     platforms = tuple(platforms)
 
     _shm_suite(results, failures, platforms)
+    if include_serve:
+        # Serve batch kernels (ISSUE 3 satellite): a lowering failure here
+        # is caught off-silicon instead of mid-warmup on the chip.
+        _serve_suite(results, failures, platforms)
     if include_x64:
         # The 64-bit mode (reference: KAMINPAR_64BIT_* switches) changes every
         # sort/segment dtype — int64 lowerings are a classic TPU divergence.

@@ -83,6 +83,12 @@ def child_attempt() -> None:
     os.environ.setdefault("KPTPU_BENCH_SCALE", "20")
     os.environ.setdefault("KPTPU_BENCH_FULL", "1")
     os.environ.setdefault("KPTPU_BENCH_FULL_SCALE", "18")
+    # Serve-mode A/B (ISSUE 3) rides run_benchmark's phase 3: warm-engine
+    # batched throughput vs the single-request pattern inside the same
+    # availability window, at a modest on-silicon workload.
+    os.environ.setdefault("KPTPU_BENCH_SERVE", "1")
+    os.environ.setdefault("KPTPU_BENCH_SERVE_REQS", "16")
+    os.environ.setdefault("KPTPU_BENCH_SERVE_SCALES", "10,12")
     from bench import run_benchmark, run_lp_phase
 
     run_benchmark()

@@ -104,3 +104,45 @@ def test_dist_preset_ladder():
     assert RefinementAlgorithm.JET in strong.refinement.algorithms
     largek = create_context_by_preset_name("dist-largek")
     assert largek.initial_partitioning.device_extension
+
+
+def test_configure_globals_first_wins_and_warns():
+    """ISSUE 3 satellite: configure_* is idempotent and re-entrancy-safe —
+    a second facade/engine instance must not clobber the first's global
+    config; conflicting settings warn instead."""
+    import warnings
+
+    import pytest
+
+    from kaminpar_tpu import context as ctx_mod
+    from kaminpar_tpu.context import ParallelContext, configure_sync_timers
+    from kaminpar_tpu.utils import timer
+
+    prev_mode = timer.sync_mode()
+    ctx_mod.reset_global_configuration()
+    try:
+        configure_sync_timers(ParallelContext(sync_timers=False))
+        # Identical settings: silent no-op (the common second-instance case).
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            configure_sync_timers(ParallelContext(sync_timers=False))
+        # Conflicting settings: warn, keep the first application.
+        with pytest.warns(RuntimeWarning, match="first-wins"):
+            configure_sync_timers(ParallelContext(sync_timers=True))
+        assert timer.sync_mode() is False
+    finally:
+        ctx_mod.reset_global_configuration()
+        timer.set_sync_mode(prev_mode)
+
+
+def test_serve_context_roundtrips_and_preset():
+    from kaminpar_tpu.config import dump_toml as _dump, load_toml as _load
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("serve")
+    ctx.serve.warm_ladder = (64, 128)
+    ctx.serve.default_deadline_ms = 250.0
+    ctx2 = _load(_dump(ctx))
+    assert ctx2.serve.warm_ladder == (64, 128)
+    assert ctx2.serve.default_deadline_ms == 250.0
+    assert ctx2.serve.max_batch == ctx.serve.max_batch

@@ -1,0 +1,330 @@
+"""Partition-serving runtime tests (ISSUE 3): engine lifecycle, bounded
+queue + admission control, deadlines, micro-batch packing, and the
+bit-identity contract — batched serve results must equal sequential
+``KaMinPar.compute_partition`` runs exactly.
+
+Tier-1 keeps small graphs (n ~ 256, the "serve" preset's fast pipeline);
+the heavy rmat/grid/star x two-buckets x two-k sweep is @slow.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators, metrics
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.serve import (
+    BoundedServeQueue,
+    DeadlineExceededError,
+    EngineStoppedError,
+    PartitionEngine,
+    QueueFullError,
+    batched_metrics,
+    form_batches,
+    pack_graphs,
+    shape_cell,
+    unpack_partition,
+)
+
+SMALL = dict(warm_ladder=(), warm_ks=(), max_batch=4, queue_bound=8)
+
+
+def _rmat(seed, scale=8):
+    return generators.rmat_graph(scale, edge_factor=4, seed=seed)
+
+
+class _Item:
+    def __init__(self, cell):
+        self.cell = cell
+
+
+# ---------------------------------------------------------------------------
+# Packing + batched metrics (single-dispatch over the union buffer)
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip():
+    graphs = [_rmat(1), generators.grid2d_graph(16, 16), generators.star_graph(99)]
+    packed = pack_graphs(graphs)
+    assert packed.num_graphs == 3
+    assert packed.union.n == sum(g.n for g in graphs)
+    assert packed.union.m == sum(g.m for g in graphs)
+    # The union is a structurally valid disjoint graph.
+    from kaminpar_tpu.graph.csr import validate
+
+    validate(packed.union)
+    # Labels round-trip through the union node space.
+    labels = np.concatenate(
+        [np.full(g.n, i, dtype=np.int32) for i, g in enumerate(graphs)]
+    )
+    parts = unpack_partition(labels, packed.node_offsets)
+    for i, (g, p) in enumerate(zip(graphs, parts)):
+        assert p.shape == (g.n,)
+        assert np.all(p == i)
+
+
+def test_batched_metrics_match_per_graph():
+    graphs = [_rmat(1), _rmat(2), generators.grid2d_graph(16, 16)]
+    k = 4
+    rng = np.random.default_rng(0)
+    parts = [rng.integers(0, k, g.n).astype(np.int32) for g in graphs]
+    cuts, bws = batched_metrics(pack_graphs(graphs), parts, k)
+    for i, g in enumerate(graphs):
+        assert int(cuts[i]) == metrics.edge_cut(g, parts[i])
+        assert np.array_equal(
+            np.asarray(bws[i]), np.asarray(metrics.block_weights(g, parts[i], k))
+        )
+
+
+def test_shape_cell_and_form_batches():
+    g = _rmat(1)
+    cell = shape_cell(g, 4)
+    assert cell.n_bucket > g.n and cell.m_bucket > g.m and cell.k == 4
+    # Same graph, same k -> same cell; different k -> different cell.
+    assert shape_cell(g, 4) == cell
+    assert shape_cell(g, 8) != cell
+
+    a, b = _Item(("x",)), _Item(("y",))
+    batches = form_batches([a, b, _Item(("x",)), _Item(("x",))], max_batch=2)
+    # FIFO-fair: head seeds the first batch, max_batch respected, the
+    # leftover same-cell item forms its own batch, order preserved.
+    assert [len(x) for x in batches] == [2, 1, 1]
+    assert batches[0][0] is a and batches[1][0] is b
+
+
+# ---------------------------------------------------------------------------
+# Bounded queue
+# ---------------------------------------------------------------------------
+
+
+def test_queue_admission_and_rejection():
+    q = BoundedServeQueue(bound=2)
+    q.put(_Item(("a",)))
+    q.put(_Item(("b",)))
+    with pytest.raises(QueueFullError):
+        q.put(_Item(("c",)))
+    batch = q.pop_batch(max_batch=4, window_s=0.0)
+    assert [i.cell for i in batch] == [("a",)]
+    q.close()
+    with pytest.raises(EngineStoppedError):
+        q.put(_Item(("d",)))
+    assert q.pop_batch(4)[0].cell == ("b",)
+    assert q.pop_batch(4) is None  # closed + drained
+
+
+def test_queue_same_cell_batch_extraction_preserves_order():
+    q = BoundedServeQueue(bound=8)
+    items = [_Item(("a",)), _Item(("b",)), _Item(("a",)), _Item(("c",))]
+    for it in items:
+        q.put(it)
+    batch = q.pop_batch(max_batch=4, window_s=0.0)
+    assert batch == [items[0], items[2]]
+    # Other cells keep FIFO order.
+    assert q.pop_batch(4, 0.0) == [items[1]]
+    assert q.pop_batch(4, 0.0) == [items[3]]
+
+
+# ---------------------------------------------------------------------------
+# Engine lifecycle: start -> warmup -> submit -> drain -> shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_engine_lifecycle_and_stats():
+    eng = PartitionEngine(
+        "serve", warm_ladder=(256,), warm_ks=(4,), max_batch=4, queue_bound=8
+    )
+    eng.start(warmup=True)
+    try:
+        assert eng.running
+        assert len(eng.warmup_report) == 1
+        row = eng.warmup_report[0]
+        assert row["k"] == 4 and row["wall_s"] > 0
+        futs = [eng.submit(_rmat(10 + i), 4) for i in range(3)]
+        results = [f.result(timeout=300) for f in futs]
+        for g, res in zip([_rmat(10 + i) for i in range(3)], results):
+            part = res.partition
+            assert part.shape == (g.n,)
+            assert part.min() >= 0 and part.max() < 4
+            assert res.cut == metrics.edge_cut(g, part)
+            assert res.feasible
+        snap = eng.stats()
+        assert snap["submitted"] == 3 and snap["completed"] == 3
+        assert snap["queue_depth"] == 0
+        assert snap["warm_cells"] >= 1
+        assert snap["latency_ms"]["total_ms"]["count"] == 3
+        # Warmup covered the (n_bucket, k) of these requests.
+        assert snap["warm_hits"] == 3, snap
+    finally:
+        eng.shutdown(drain=True)
+    assert not eng.running
+    with pytest.raises(EngineStoppedError):
+        eng.submit(_rmat(1), 4)
+
+
+def test_engine_restart_after_shutdown():
+    """start() (including the partition() auto-start) must fully revive a
+    shut-down engine: fresh queue, live dispatcher, warm state retained."""
+    eng = PartitionEngine("serve", **SMALL)
+    eng.start(warmup=False)
+    g = _rmat(60)
+    first = eng.partition(_rmat(60), 4)
+    eng.shutdown(drain=True)
+    assert not eng.running
+    # Auto-start path (what facade delegation hits after a shutdown).
+    again = eng.partition(_rmat(60), 4)
+    assert eng.running
+    assert np.array_equal(first, again)
+    assert g.n == again.shape[0]
+    eng.shutdown(drain=True)
+
+
+def test_engine_shutdown_without_drain_rejects_queued():
+    eng = PartitionEngine("serve", **SMALL)
+    eng.pause()  # engaged before start: the dispatcher never pops
+    eng.start(warmup=False)
+    futs = [eng.submit(_rmat(20 + i), 4) for i in range(2)]
+    eng.shutdown(drain=False, timeout_s=30)
+    for f in futs:
+        with pytest.raises(EngineStoppedError):
+            f.result(timeout=30)
+
+
+def test_engine_queue_full_rejection_with_retry_after():
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          max_batch=1, queue_bound=2)
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        eng.submit(_rmat(30), 4)
+        eng.submit(_rmat(31), 4)
+        with pytest.raises(QueueFullError) as exc:
+            eng.submit(_rmat(32), 4)
+        assert exc.value.retry_after_s > 0
+        assert eng.stats_.counter("rejected_full") == 1
+    finally:
+        eng.resume()
+        eng.shutdown(drain=True)
+
+
+def test_engine_deadline_timeout_in_queue():
+    eng = PartitionEngine("serve", **SMALL)
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        fut = eng.submit(_rmat(40), 4, deadline_ms=10)
+        time.sleep(0.05)
+        eng.resume()
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=60)
+        assert eng.stats_.counter("timed_out") == 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_engine_cancel_before_execution():
+    eng = PartitionEngine("serve", **SMALL)
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        fut = eng.submit(_rmat(41), 4)
+        assert fut.cancel()
+        eng.resume()
+        from kaminpar_tpu.serve import RequestCancelledError
+
+        with pytest.raises(RequestCancelledError):
+            fut.result(timeout=60)
+        assert eng.stats_.counter("cancelled") == 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: batched serve == sequential facade (the PR 1/2 discipline)
+# ---------------------------------------------------------------------------
+
+
+def _assert_batched_equals_sequential(graph_fns, k, max_batch=8):
+    """Burst-submit all graphs (paused engine -> deterministic batches),
+    then compare every result against a fresh sequential facade run."""
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          max_batch=max_batch, queue_bound=64)
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        futs = [eng.submit(fn(), k) for fn in graph_fns]
+        eng.resume()
+        results = [f.result(timeout=600) for f in futs]
+    finally:
+        eng.shutdown(drain=True)
+    occupancies = []
+    for fn, res in zip(graph_fns, results):
+        solo = KaMinPar(ctx="serve")
+        solo.set_graph(fn())
+        expected = solo.compute_partition(k, 0.03)
+        assert np.array_equal(res.partition, expected), (
+            f"batched result (batch={res.batch_size}) differs from the "
+            f"sequential facade run for k={k}"
+        )
+        occupancies.append(res.batch_size)
+    return occupancies
+
+
+def test_batched_bit_identity_same_cell():
+    # Four same-scale RMAT graphs; same-cell ones are micro-batched and
+    # every result must equal its solo sequential run bit-for-bit.
+    occ = _assert_batched_equals_sequential(
+        [lambda s=s: _rmat(100 + s) for s in range(4)], k=4
+    )
+    assert max(occ) >= 2, f"expected some batching, got occupancies {occ}"
+
+
+def test_batched_bit_identity_mixed_cells():
+    # Mixed families and two k values: cells differ, batches split, and
+    # identity still holds for every request.
+    fns = [
+        lambda: _rmat(7),
+        lambda: generators.grid2d_graph(16, 16),
+        lambda: _rmat(8),
+    ]
+    _assert_batched_equals_sequential(fns, k=4)
+    _assert_batched_equals_sequential([lambda: _rmat(9)], k=8)
+
+
+def test_facade_delegates_to_engine():
+    g = _rmat(50)
+    solo = KaMinPar(ctx="serve")
+    solo.set_graph(g)
+    expected = solo.compute_partition(4, 0.03)
+    with PartitionEngine("serve", **SMALL) as eng:
+        # Sync convenience wrapper...
+        direct = eng.partition(_rmat(50), 4)
+        # ...and facade delegation.
+        facade = KaMinPar(ctx="serve", engine=eng)
+        facade.set_graph(_rmat(50))
+        delegated = facade.compute_partition(4, 0.03)
+    assert np.array_equal(direct, expected)
+    assert np.array_equal(delegated, expected)
+
+
+@pytest.mark.slow
+def test_batched_bit_identity_sweep():
+    """The full ISSUE-3 sweep: rmat/grid/star at two buckets and two k
+    values, batched-vs-sequential identity for every combination."""
+    families = {
+        "rmat": lambda scale, seed: generators.rmat_graph(
+            scale, edge_factor=4, seed=seed
+        ),
+        "grid": lambda scale, seed: generators.grid2d_graph(
+            1 << (scale // 2), 1 << (scale - scale // 2)
+        ),
+        "star": lambda scale, seed: generators.star_graph((1 << scale) - 1),
+    }
+    for name, fn in families.items():
+        for scale in (8, 10):  # two node buckets
+            for k in (4, 8):
+                occ = _assert_batched_equals_sequential(
+                    [lambda s=s: fn(scale, 200 + s) for s in range(3)], k=k
+                )
+                if name == "rmat":
+                    assert max(occ) >= 1, (name, scale, k, occ)

@@ -109,3 +109,12 @@ def test_compression_tool(metis_file):
     out = _run_tool("compression", metis_file[0])
     assert out.returncode == 0, out.stderr
     assert "ratio:" in out.stdout
+
+
+def test_warmup_tool():
+    """`tools warmup` precompiles a (tiny) serving ladder and reports the
+    per-bucket compile seconds from compile_stats (ISSUE 3 satellite)."""
+    out = _run_tool("warmup", "--ladder", "64", "--ks", "4", "-P", "serve")
+    assert out.returncode == 0, out.stderr
+    assert "cell n_bucket=" in out.stdout
+    assert "distinct kernel specializations" in out.stdout
