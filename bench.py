@@ -182,6 +182,70 @@ def run_lp_phase() -> dict:
     return record
 
 
+def _timer_phase_seconds(*path: str) -> float | None:
+    """Elapsed seconds of a timer-tree scope by path (e.g. "partitioning",
+    "initial_partitioning"); None when the scope never ran."""
+    from kaminpar_tpu.utils import Timer
+
+    node = Timer.global_()._root
+    for name in path:
+        node = node.children.get(name)
+        if node is None:
+            return None
+    return node.elapsed
+
+
+def _run_ip_ab(k: int) -> dict:
+    """Initial-partitioning A/B (ISSUE 4 acceptance): wall of the same
+    k-way recursive bisection on the host pool vs the lane-vmapped device
+    pool, on a coarsest-graph-sized instance.  The device number is
+    reported cold (first call pays per-cell compiles; the persistent cache
+    keeps them paid) and warm (the steady-state cost every level of a real
+    run pays)."""
+    import dataclasses
+
+    import numpy as np
+
+    from kaminpar_tpu.context import InitialPartitioningContext
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.initial.bipartitioner import _cut, recursive_bipartition
+    from kaminpar_tpu.partitioning.kway import graph_to_host
+
+    scale = int(os.environ.get("KPTPU_BENCH_IP_SCALE", 12))
+    host = graph_to_host(rmat_graph(scale, edge_factor=8, seed=2))
+    W = host.total_node_weight
+    per = int(np.ceil(W / k) * 1.03) + 1
+    budgets = np.full(k, per, dtype=np.int64)
+    out: dict = {"scale": scale, "k": k}
+    # The KAMINPAR_TPU_IP_BACKEND kill switch overrides the context knob,
+    # which would make both A/B arms silently run the same pool; the A/B
+    # pins each arm explicitly, so lift the override for its duration.
+    env_override = os.environ.pop("KAMINPAR_TPU_IP_BACKEND", None)
+    try:
+        for backend in ("host", "device"):
+            ipc = dataclasses.replace(
+                InitialPartitioningContext(), ip_backend=backend
+            )
+            walls = []
+            for rep in range(2):
+                t0 = time.perf_counter()
+                part = recursive_bipartition(
+                    host, k, budgets, np.random.default_rng(1), ipc
+                )
+                walls.append(time.perf_counter() - t0)
+            out[f"{backend}_cold_s"] = round(walls[0], 3)
+            out[f"{backend}_warm_s"] = round(walls[1], 3)
+            out[f"{backend}_cut"] = _cut(host, part)
+    finally:
+        if env_override is not None:
+            os.environ["KAMINPAR_TPU_IP_BACKEND"] = env_override
+    if out["device_warm_s"]:
+        out["device_vs_host_warm"] = round(
+            out["host_warm_s"] / out["device_warm_s"], 2
+        )
+    return out
+
+
 def run_full_phase(record: dict | None = None) -> dict:
     """Phase 2: end-to-end compute_partition wall-clock (VERDICT r4 weak #2 —
     never recorded by any BENCH artifact before r5).  Scale defaults to one
@@ -210,6 +274,10 @@ def run_full_phase(record: dict | None = None) -> dict:
     default_full = 20 if on_accel else 17
     full_scale = int(os.environ.get("KPTPU_BENCH_FULL_SCALE", default_full))
 
+    from kaminpar_tpu.initial.bipartitioner import resolve_ip_backend
+    from kaminpar_tpu.ops import bipartition as ip_pool
+
+    ip_pool.reset_pool_stats()
     RandomState.reseed(0)
     fgraph = rmat_graph(full_scale, edge_factor=16, seed=1)
     shm = KaMinPar(ctx=Context())
@@ -218,6 +286,11 @@ def run_full_phase(record: dict | None = None) -> dict:
     part = shm.compute_partition(k, epsilon=0.03)
     wall = time.perf_counter() - t0
     cut = int(edge_cut(fgraph, part))
+    # Initial-partitioning share of the partition wall + device-pool lane
+    # census (ISSUE 4): occupancy = requested repetitions / bucketed lanes
+    # launched; zero calls on the host backend is the honest CPU reading.
+    ip_wall = _timer_phase_seconds("partitioning", "initial_partitioning")
+    part_wall = _timer_phase_seconds("partitioning")
     # Distinct kernel specializations + actual compile wall-time of the
     # full-partition phase — the cold-compile tax the geometric shape
     # buckets bound (ISSUE 1; one ~35-48 s compile per shape on a tunneled
@@ -233,6 +306,12 @@ def run_full_phase(record: dict | None = None) -> dict:
         "partition_edges_per_sec": round(fgraph.m / wall, 1),
         "compiled_shape_count": shape_counts,
         "partition_compile": compile_stats.compile_time_snapshot(),
+        "ip_backend": resolve_ip_backend(shm.ctx.initial_partitioning),
+        "initial_partitioning_wall_s": round(ip_wall, 3)
+        if ip_wall is not None else None,
+        "initial_partitioning_share": round(ip_wall / part_wall, 4)
+        if ip_wall is not None and part_wall else None,
+        "ip_pool": ip_pool.pool_stats_snapshot(),
         # Blocking-transfer census of the full-partition phase: total count
         # + per-phase {count, bytes} keyed by the timer tree's scope names
         # (the one-batched-readback-per-coarsening-level contract shows up
@@ -241,6 +320,13 @@ def run_full_phase(record: dict | None = None) -> dict:
         "host_sync_bytes": sync_snap["bytes"],
         "host_sync": sync_snap["phases"],
     })
+    # Measured host-vs-device pool speedup (ISSUE 4 acceptance); an A/B
+    # failure must not void the partition record above.
+    if os.environ.get("KPTPU_BENCH_IP_AB", "1") == "1":
+        try:
+            record["ip_ab"] = _run_ip_ab(k=min(k, 8))
+        except Exception as exc:  # noqa: BLE001
+            record["ip_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(record), flush=True)
     return record
 
@@ -540,7 +626,10 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
             for key in ("partition_wall_s", "partition_cut", "partition_scale",
                         "partition_k", "partition_edges_per_sec",
                         "compiled_shape_count", "partition_compile",
-                        "host_sync_count", "host_sync_bytes", "host_sync"):
+                        "host_sync_count", "host_sync_bytes", "host_sync",
+                        "ip_backend", "initial_partitioning_wall_s",
+                        "initial_partitioning_share", "ip_pool", "ip_ab",
+                        "ip_ab_error"):
                 if key in full_rec:
                     rec[key] = full_rec[key]
         else:

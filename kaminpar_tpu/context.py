@@ -206,6 +206,15 @@ class InitialPartitioningContext:
     bipartitioners + 2-way FM (initial_pool_bipartitioner.cc:24)."""
 
     mode: InitialPartitioningMode = InitialPartitioningMode.SEQUENTIAL
+    # Bipartitioning-pool backend (round 9, ISSUE 4): "host" = the
+    # sequential NumPy pool + mini-multilevel below (the reference-faithful
+    # oracle), "device" = every repetition as a vmapped lane of the JAX pool
+    # (ops/bipartition.py; one blocking readback per bisection, per-lane
+    # streams from utils/rng.lane_keys), "auto" = device on accelerator
+    # backends, host on CPU.  The host pool stays the fallback: a device
+    # dispatch failure falls back per bisection instead of aborting.
+    # KAMINPAR_TPU_IP_BACKEND overrides.
+    ip_backend: str = "auto"
     # Spend the imbalance budget evenly across bisection levels (reference:
     # use_adaptive_epsilon / create_twoway_context, helper.cc:103-130).
     use_adaptive_epsilon: bool = True

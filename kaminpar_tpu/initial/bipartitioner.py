@@ -307,6 +307,47 @@ def _contract_host(g: HostCSR, labels: np.ndarray) -> Tuple[HostCSR, np.ndarray]
     return HostCSR(row_ptr, cv2, node_w, ew), cmap
 
 
+def resolve_ip_backend(ctx: Optional[InitialPartitioningContext]) -> str:
+    """Env kill switch (KAMINPAR_TPU_IP_BACKEND) > context knob; "auto"
+    resolves to the device pool on accelerator backends and the host pool on
+    CPU (mirroring csr.resolve_layout_build_mode)."""
+    import os
+
+    import jax
+
+    mode = (
+        os.environ.get("KAMINPAR_TPU_IP_BACKEND", "")
+        or (ctx.ip_backend if ctx is not None else "auto")
+        or "auto"
+    )
+    if mode not in ("host", "device", "auto"):
+        raise ValueError(
+            f"ip_backend must be 'host', 'device' or 'auto', got {mode!r}"
+        )
+    if mode == "auto":
+        return "device" if jax.default_backend() != "cpu" else "host"
+    return mode
+
+
+def _device_bipartition(
+    g: HostCSR, max_w: np.ndarray, rng, ctx: InitialPartitioningContext,
+    final_k: int,
+) -> np.ndarray:
+    """One bisection on the device pool (ops/bipartition.py): every
+    repetition a vmapped lane, lane selection on device, ONE blocking
+    readback.  Replaces the host mini-multilevel wholesale — the lane stack
+    plus the round-based device refiner is the parallelism that hierarchy
+    bought the sequential pool.  Draws one seed from the host stream so the
+    recursion stays deterministic in (graph, seed) for this backend."""
+    from ..ops.bipartition import pool_bipartition_device
+
+    seed = int(rng.integers(1 << 62))
+    labels, _ = pool_bipartition_device(
+        g.row_ptr, g.col_idx, g.node_w, g.edge_w, max_w, seed, ctx, final_k
+    )
+    return labels
+
+
 def multilevel_bipartition(
     g: HostCSR,
     max_w: np.ndarray,
@@ -325,6 +366,25 @@ def multilevel_bipartition(
     match on non-trivial coarse graphs (VERDICT r1 missing #8).
     """
     ctx = ctx or InitialPartitioningContext()
+    if g.n > 2 and resolve_ip_backend(ctx) == "device":
+        try:
+            return _device_bipartition(g, max_w, rng, ctx, final_k)
+        except Exception as exc:  # noqa: BLE001 — host pool is the fallback
+            import warnings
+
+            from ..ops.bipartition import count_pool_fallback
+
+            # Loud + counted: a systematic kernel regression would otherwise
+            # silently serve every bisection from the host pool while bench
+            # reports ip_backend="device" (the counter rides its ip_pool
+            # census as "fallbacks").
+            count_pool_fallback()
+            warnings.warn(
+                f"device IP pool failed ({type(exc).__name__}: {exc}); "
+                "falling back to the host pool for this bisection",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     C = ctx.coarsening_contraction_limit
     total = g.total_node_weight
 

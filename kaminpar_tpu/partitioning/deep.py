@@ -18,7 +18,11 @@ from ..context import Context
 from ..factories import create_refiner
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
-from ..initial.bipartitioner import extract_all_subgraphs, recursive_bipartition
+from ..initial.bipartitioner import (
+    extract_all_subgraphs,
+    recursive_bipartition,
+    resolve_ip_backend,
+)
 from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
@@ -326,12 +330,20 @@ class DeepMultilevelPartitioner:
                 budgets = intermediate_block_weights(
                     np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
                 )
+                sync_pre_ip = sync_stats.phase_count("initial_partitioning")
                 with scoped_timer("initial_partitioning"):
-                    # Host phase by design (the reference is sequential here
-                    # too); its bulk pull is attributed to this scope.
+                    # Orchestration stays host-side (the reference is
+                    # sequential here too), but each bisection's pool runs on
+                    # the ip_backend; every pull lands in this scope.
                     host = graph_to_host(coarsest)
                     part = recursive_bipartition(
                         host, cur_k, budgets, rng, ctx.initial_partitioning
+                    )
+                if resolve_ip_backend(ctx.initial_partitioning) == "device":
+                    # 1 packed bulk graph pull + <= 1 readback per bisection
+                    # (cur_k - 1 bisections): the device pool's contract.
+                    sync_stats.assert_phase_budget(
+                        "initial_partitioning", max(cur_k, 1), since=sync_pre_ip
                     )
             p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
             p_graph = self._restrict(

@@ -9,10 +9,13 @@ whole graph:
 1. **Restricted coarsening (device)**: coarsen with communities = the
    current blocks, so clusters never span blocks — the same masked-rating
    machinery v-cycle coarsening already uses (cluster_coarsener.coarsen_once).
-2. **Host extension of the coarsest level only**: the nested coarsest graph
+2. **Extension of the coarsest level only**: the nested coarsest graph
    (~``device_extension_cpb`` coarse nodes per new block) goes through the
-   existing host pool machinery (BFS/GGG/random + 2-way FM per block).  This
-   is the only host step, O(n_coarsest) instead of O(n) per level.
+   existing pool machinery per block — host BFS/GGG/random + 2-way FM, or
+   the lane-vmapped device pool when ``ip_backend`` resolves to device
+   (round 9: each bisection then costs one dispatch + one readback instead
+   of a Python repetition loop).  This is the only non-device-resident
+   step, O(n_coarsest) instead of O(n) per level.
 3. **Restricted uncoarsening (device)**: project up; at each level zero the
    cross-block edge weights and run the grouped overload balancer + the LP
    refiner with the intermediate new-k budgets.  Ratings of masked edges are

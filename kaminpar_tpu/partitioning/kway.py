@@ -22,11 +22,26 @@ from ..utils.timer import scoped_timer
 
 
 def graph_to_host(graph: CSRGraph) -> HostCSR:
+    """Materialize a device CSR on the host as ONE blocking transfer: the
+    four arrays ride a single device-side concat + ``sync_stats.pull``
+    instead of four separate readbacks (round 9: the initial-partitioning
+    phase budget counts pulls, so the bulk graph pull must cost one)."""
     from ..utils import sync_stats
 
-    rp, col, nw, ew = sync_stats.pull(
-        graph.row_ptr, graph.col_idx, graph.node_w, graph.edge_w
-    )
+    import functools
+
+    import jax.numpy as jnp
+
+    arrays = (graph.row_ptr, graph.col_idx, graph.node_w, graph.edge_w)
+    # Promote to one dtype so mixed-dtype (hand-built) graphs still cost a
+    # single pull — a 4-array fallback would blow the k-pull phase budget.
+    dt = functools.reduce(jnp.promote_types, (a.dtype for a in arrays))
+    packed = sync_stats.pull(jnp.concatenate([a.astype(dt) for a in arrays]))
+    n, m = graph.n, graph.m
+    rp = packed[: n + 1]
+    col = packed[n + 1 : n + 1 + m]
+    nw = packed[n + 1 + m : n + 1 + m + n]
+    ew = packed[n + 1 + m + n :]
     return HostCSR(
         rp.astype(np.int64),
         col.astype(np.int64),
@@ -37,17 +52,29 @@ def graph_to_host(graph: CSRGraph) -> HostCSR:
 
 def initial_partition(graph: CSRGraph, ctx: Context) -> np.ndarray:
     """k-way initial partition of the coarsest graph via recursive bisection
-    on host (SURVEY §7 stage 5: the reference is sequential here too)."""
-    host = graph_to_host(graph)
+    (SURVEY §7 stage 5); the pool inside each bisection runs on the backend
+    ``InitialPartitioningContext.ip_backend`` resolves to."""
+    from ..initial.bipartitioner import resolve_ip_backend
+    from ..utils import sync_stats
+
     rng = RandomState.numpy_rng()
+    pre = sync_stats.phase_count("initial_partitioning")
     with scoped_timer("initial_partitioning"):
-        return recursive_bipartition(
+        host = graph_to_host(graph)
+        part = recursive_bipartition(
             host,
             ctx.partition.k,
             np.asarray(ctx.partition.max_block_weights, dtype=np.int64),
             rng,
             ctx.initial_partitioning,
         )
+    if resolve_ip_backend(ctx.initial_partitioning) == "device":
+        # 1 packed bulk graph pull + <= 1 readback per bisection (k-1
+        # bisections produce k blocks); armed via enable_budget_checks.
+        sync_stats.assert_phase_budget(
+            "initial_partitioning", max(ctx.partition.k, 1), since=pre
+        )
+    return part
 
 
 class KWayMultilevelPartitioner:

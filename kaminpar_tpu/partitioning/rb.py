@@ -14,7 +14,7 @@ import numpy as np
 from ..context import Context, PartitioningMode
 from ..graph.csr import CSRGraph, from_numpy_csr
 from ..graph.partitioned import PartitionedGraph
-from ..initial.bipartitioner import HostCSR, extract_subgraph
+from ..initial.bipartitioner import extract_subgraph
 from ..utils.timer import scoped_timer
 
 
@@ -43,12 +43,11 @@ class RBMultilevelPartitioner:
         budgets = np.array([max_bw[:k0].sum(), max_bw[k0:].sum()], dtype=np.int64)
         bi = self._bisect(graph, budgets)
         part = np.zeros(graph.n, dtype=np.int32)
-        host = HostCSR(
-            np.asarray(graph.row_ptr).astype(np.int64),
-            np.asarray(graph.col_idx).astype(np.int64),
-            np.asarray(graph.node_w).astype(np.int64),
-            np.asarray(graph.edge_w).astype(np.int64),
-        )
+        # One counted packed pull (round-9 stray-sync audit) instead of four
+        # uncounted np.asarray transfers of the device arrays.
+        from .kway import graph_to_host
+
+        host = graph_to_host(graph)
         for side, (kk, offset) in enumerate(((k0, 0), (k1, k0))):
             sub, nodes = extract_subgraph(host, bi, side)
             if kk > 1:

@@ -236,6 +236,11 @@ def create_serve_context() -> Context:
     ctx.preset_name = "serve"
     ctx.serve.max_batch = 8
     ctx.serve.queue_bound = 64
+    # Explicit (== the default) so the serving intent is self-documenting:
+    # on accelerator backends the warm engine runs the lane-vmapped device
+    # pool (ops/bipartition.py) — its (bucket, lane-count, k=2) cells are
+    # precompiled by engine warmup — while CPU engines keep the host pool.
+    ctx.initial_partitioning.ip_backend = "auto"
     return ctx
 
 

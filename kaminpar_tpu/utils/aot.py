@@ -172,6 +172,38 @@ def _shm_suite(results, failures, platforms, *, use_64bit: bool = False):
     )
 
 
+def _initial_suite(results, failures, platforms, *, use_64bit: bool = False):
+    """The lane-vmapped initial-bipartitioning pool (ISSUE 4): engine warmup
+    / the first on-silicon bisection must not be where the vmapped
+    grow/rebalance/FM stack meets the TPU lowering rules."""
+    from ..context import InitialPartitioningContext
+    from ..graph import generators
+    from ..ops.bipartition import (
+        _pool_kernel,
+        fm_round_count,
+        grow_trip_count,
+        method_lane_counts,
+    )
+    from ..utils.rng import lane_keys
+
+    sfx = "_x64" if use_64bit else ""
+    g = generators.rmat_graph(7, 8, seed=2, use_64bit=use_64bit)
+    pv = g.padded()
+    idt = pv.node_w.dtype
+    ipc = InitialPartitioningContext()
+    methods, _ = method_lane_counts(ipc, final_k=8)
+    keys = lane_keys(0, sum(cnt for _, cnt in methods))
+    _export_one(
+        results, failures, f"ip_pool{sfx}", _pool_kernel,
+        keys, pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w,
+        jnp.asarray(pv.n, dtype=idt), jnp.asarray(64, dtype=idt),
+        jnp.asarray(80, dtype=idt), jnp.asarray(80, dtype=idt),
+        methods=methods, grow_trips=grow_trip_count(pv.n_pad),
+        fm_rounds=fm_round_count(pv.n_pad, ipc.fm_num_iterations),
+        platforms=platforms,
+    )
+
+
 def _serve_suite(results, failures, platforms):
     """The serving runtime's batch kernels (serve/batching.py): packed
     disjoint-union metrics over two graphs in one cell.  Warmup on silicon
@@ -306,6 +338,7 @@ def export_kernel_suite(
     include_dist: bool = True,
     include_x64: bool = True,
     include_serve: bool = True,
+    include_initial: bool = True,
     mesh=None,
 ) -> Dict[str, int]:
     """Export every kernel for the target platform(s); returns name -> bytes
@@ -325,11 +358,17 @@ def export_kernel_suite(
         # Serve batch kernels (ISSUE 3 satellite): a lowering failure here
         # is caught off-silicon instead of mid-warmup on the chip.
         _serve_suite(results, failures, platforms)
+    if include_initial:
+        # The vmapped bipartitioning pool (ISSUE 4): warmed per cell by the
+        # serve engine, so it must lower before it meets the chip.
+        _initial_suite(results, failures, platforms)
     if include_x64:
         # The 64-bit mode (reference: KAMINPAR_64BIT_* switches) changes every
         # sort/segment dtype — int64 lowerings are a classic TPU divergence.
         with jax.enable_x64(True):
             _shm_suite(results, failures, platforms, use_64bit=True)
+            if include_initial:
+                _initial_suite(results, failures, platforms, use_64bit=True)
     if include_dist:
         if mesh is None:
             from jax.sharding import Mesh

@@ -56,3 +56,36 @@ def reseed(seed: int) -> None:
 
 def next_key():
     return RandomState.next_key()
+
+
+# ---------------------------------------------------------------------------
+# Per-lane counter-based key derivation (round 9, ISSUE 4).
+#
+# Lane-stacked (vmapped) pipelines need an *identity-preserving* per-lane
+# stream: lane i's draws must depend only on (seed, i) — never on how many
+# lanes run beside it, nor on whether the stack executes as vmap, scan, or a
+# Python loop.  ``fold_in`` is exactly that counter-based construction: it
+# hashes (key, lane_index) with no sequential state, so
+#   lane_keys(seed, R)[i] == lane_key(seed, i)        for every R > i
+# and the three execution orders produce bit-identical draws (asserted in
+# tests/test_rng.py, including across process restarts).  This is the scheme
+# the ROADMAP's serve lane-stacking item names; its first consumer is the
+# device initial-bipartitioning pool (ops/bipartition.py).
+# ---------------------------------------------------------------------------
+
+
+def lane_key(seed: int, lane):
+    """Key of lane ``lane`` under graph seed ``seed`` (lane-count invariant).
+
+    ``lane`` may be a Python int or a traced int32 scalar (so the derivation
+    can run inside jit/vmap)."""
+    return jax.random.fold_in(jax.random.key(int(seed)), lane)
+
+
+def lane_keys(seed: int, n_lanes: int):
+    """Stacked keys of lanes ``0..n_lanes-1`` — ``lane_keys(s, R)[i]`` is
+    bit-identical to ``lane_key(s, i)`` for every R."""
+    base = jax.random.key(int(seed))
+    return jax.vmap(lambda l: jax.random.fold_in(base, l))(
+        jax.numpy.arange(n_lanes, dtype=jax.numpy.uint32)
+    )

@@ -89,6 +89,12 @@ def child_attempt() -> None:
     os.environ.setdefault("KPTPU_BENCH_SERVE", "1")
     os.environ.setdefault("KPTPU_BENCH_SERVE_REQS", "16")
     os.environ.setdefault("KPTPU_BENCH_SERVE_SCALES", "10,12")
+    # Initial-partitioning pool A/B (ISSUE 4) rides phase 2: host pool vs
+    # the lane-vmapped device pool at a deep-pipeline coarsest-graph size
+    # (2C = 4000 nodes ~ scale 12).  The new ip_backend / ip_pool /
+    # initial_partitioning_* keys land in the same salvaged record.
+    os.environ.setdefault("KPTPU_BENCH_IP_AB", "1")
+    os.environ.setdefault("KPTPU_BENCH_IP_SCALE", "12")
     from bench import run_benchmark, run_lp_phase
 
     run_benchmark()

@@ -85,6 +85,23 @@ def test_recursive_bipartition_odd_k(grid_host, rng):
     assert (bw <= mw).all()
 
 
+def test_graph_to_host_packed_single_pull():
+    """graph_to_host materializes all four CSR arrays through ONE counted
+    blocking transfer (round 9: the initial-partitioning phase budget counts
+    pulls, so the bulk graph pull must cost exactly one)."""
+    from kaminpar_tpu.utils import sync_stats
+
+    g = generators.rmat_graph(6, 4, seed=2)
+    pre = sync_stats.phase_count("ip_pull_test")
+    with sync_stats.scoped("ip_pull_test"):
+        host = graph_to_host(g)
+    assert sync_stats.phase_count("ip_pull_test") - pre == 1
+    np.testing.assert_array_equal(host.row_ptr, np.asarray(g.row_ptr))
+    np.testing.assert_array_equal(host.col_idx, np.asarray(g.col_idx))
+    np.testing.assert_array_equal(host.node_w, np.asarray(g.node_w))
+    np.testing.assert_array_equal(host.edge_w, np.asarray(g.edge_w))
+
+
 def _to_host(g):
     from kaminpar_tpu.initial.bipartitioner import HostCSR
 

@@ -44,6 +44,10 @@ def test_kernel_suite_lowers_for_tpu():
         # Serve batch kernels (ISSUE 3): engine warmup on silicon must not
         # be the first place they meet the TPU lowering rules.
         "serve_packed_metrics",
+        # The lane-vmapped initial-bipartitioning pool (ISSUE 4), both
+        # index widths — engine warmup compiles it per cell at startup.
+        "ip_pool",
+        "ip_pool_x64",
     ):
         assert name in sizes
     # Cumulative serialized size is the suite's budget metric: a serialized
