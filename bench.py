@@ -184,15 +184,11 @@ def run_lp_phase() -> dict:
 
 def _timer_phase_seconds(*path: str) -> float | None:
     """Elapsed seconds of a timer-tree scope by path (e.g. "partitioning",
-    "initial_partitioning"); None when the scope never ran."""
+    "initial_partitioning"); None when the scope never ran.  Reads the
+    merged (all-threads) tree via the public Timer API."""
     from kaminpar_tpu.utils import Timer
 
-    node = Timer.global_()._root
-    for name in path:
-        node = node.children.get(name)
-        if node is None:
-            return None
-    return node.elapsed
+    return Timer.global_().phase_seconds(*path)
 
 
 def _run_ip_ab(k: int) -> dict:
@@ -276,15 +272,30 @@ def run_full_phase(record: dict | None = None) -> dict:
 
     from kaminpar_tpu.initial.bipartitioner import resolve_ip_backend
     from kaminpar_tpu.ops import bipartition as ip_pool
+    from kaminpar_tpu.telemetry import trace as ttrace
+    from kaminpar_tpu.utils import heap_profiler
+    from kaminpar_tpu.utils.heap_profiler import HeapProfiler
 
     ip_pool.reset_pool_stats()
     RandomState.reseed(0)
     fgraph = rmat_graph(full_scale, edge_factor=16, seed=1)
     shm = KaMinPar(ctx=Context())
     shm.set_graph(fgraph)
+    # Run telemetry (ISSUE 5): the full-partition phase records the unified
+    # trace — spans, per-level quality rows, sync/compile/HBM counter
+    # samples — and the artifact carries its summary + the trace path.
+    trace_out = os.environ.get(
+        "KPTPU_BENCH_TRACE_OUT", os.path.join(REPO, "BENCH_trace.json")
+    )
+    trace_rec = None if ttrace.active() is not None else ttrace.start()
+    HeapProfiler.reset(enabled=True)
     t0 = time.perf_counter()
-    part = shm.compute_partition(k, epsilon=0.03)
-    wall = time.perf_counter() - t0
+    try:
+        part = shm.compute_partition(k, epsilon=0.03)
+    finally:
+        wall = time.perf_counter() - t0
+        if trace_rec is not None:
+            ttrace.stop()
     cut = int(edge_cut(fgraph, part))
     # Initial-partitioning share of the partition wall + device-pool lane
     # census (ISSUE 4): occupancy = requested repetitions / bucketed lanes
@@ -320,6 +331,29 @@ def run_full_phase(record: dict | None = None) -> dict:
         "host_sync_bytes": sync_snap["bytes"],
         "host_sync": sync_snap["phases"],
     })
+    # Telemetry summary (ISSUE 5): trace path + per-level quality rows +
+    # the HBM watermark, embedded so BENCH_*.json / TPU_PROBE_LOG.jsonl
+    # carry the run's structured record.
+    if trace_rec is not None:
+        try:
+            trace_rec.meta.update(
+                {"scale": full_scale, "k": k, "backend": backend}
+            )
+            trace_rec.write(trace_out)
+            record["telemetry"] = {
+                "trace_path": trace_out,
+                **trace_rec.summary(),
+                # Cap the embedded rows so a deep hierarchy cannot bloat the
+                # one-line artifact; the full set lives in the trace file.
+                "levels": trace_rec.quality[:48],
+                "hbm": heap_profiler.watermark_report(),
+            }
+        except Exception as exc:  # noqa: BLE001 — telemetry must not void the record
+            record["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # Watermark captured — disarm the profiler so the serve phase's measured
+    # request path does not pay per-scope allocator queries or accumulate
+    # unbounded per-request heap-tree nodes.
+    HeapProfiler.reset(enabled=False)
     # Measured host-vs-device pool speedup (ISSUE 4 acceptance); an A/B
     # failure must not void the partition record above.
     if os.environ.get("KPTPU_BENCH_IP_AB", "1") == "1":
@@ -629,7 +663,7 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
                         "host_sync_count", "host_sync_bytes", "host_sync",
                         "ip_backend", "initial_partitioning_wall_s",
                         "initial_partitioning_share", "ip_pool", "ip_ab",
-                        "ip_ab_error"):
+                        "ip_ab_error", "telemetry", "telemetry_error"):
                 if key in full_rec:
                     rec[key] = full_rec[key]
         else:

@@ -60,6 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference: --vcycles)")
     p.add_argument("--heap-profile", action="store_true",
                    help="print device allocator statistics after partitioning")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome trace-event / Perfetto JSON of the "
+                        "run: timer-tree spans, per-level quality probes, "
+                        "sync/compile/memory counter samples")
+    p.add_argument("--profile-phases", default=None, metavar="P1,P2,...",
+                   help="arm jax.profiler around these phases (needs "
+                        "--trace-out; XLA capture lands in "
+                        "<trace-out>.profile/)")
     p.add_argument("-C", "--config", default=None, metavar="FILE",
                    help="load a TOML config over the chosen preset")
     p.add_argument("--dump-config", action="store_true",
@@ -85,6 +93,10 @@ def main(argv=None) -> int:
         return 0
     if args.graph is None or args.k is None:
         parser.error("graph and k are required (unless --dump-config)")
+    if args.profile_phases and not args.trace_out:
+        # Reject the invalid combination before the (possibly multi-minute)
+        # graph read, not after.
+        parser.error("--profile-phases requires --trace-out")
 
     if args.quiet:
         Logger.level = OutputLevel.QUIET
@@ -117,17 +129,51 @@ def main(argv=None) -> int:
         f"(read in {time.perf_counter() - t0:.2f}s)"
     )
 
+    trace_rec = None
+    if args.trace_out:
+        from .telemetry import trace as ttrace
+
+        profile_phases = tuple(
+            s.strip() for s in (args.profile_phases or "").split(",") if s.strip()
+        )
+        trace_rec = ttrace.start(
+            profile_phases=profile_phases,
+            profile_dir=args.trace_out + ".profile",
+        )
+        trace_rec.meta.update({
+            "graph": args.graph, "k": int(args.k), "preset": args.preset,
+            "seed": ctx.seed,
+        })
+
     solver = KaMinPar(ctx)
     solver.set_graph(graph)
-    part = solver.compute_partition(
-        k=args.k,
-        epsilon=args.epsilon if args.epsilon is not None else ctx.partition.epsilon,
-        min_epsilon=(
-            args.min_epsilon
-            if args.min_epsilon is not None
-            else ctx.partition.min_epsilon
-        ),
-    )
+    try:
+        part = solver.compute_partition(
+            k=args.k,
+            epsilon=args.epsilon if args.epsilon is not None else ctx.partition.epsilon,
+            min_epsilon=(
+                args.min_epsilon
+                if args.min_epsilon is not None
+                else ctx.partition.min_epsilon
+            ),
+        )
+    finally:
+        if trace_rec is not None:
+            from .telemetry import trace as ttrace
+
+            ttrace.stop()
+            try:
+                trace_rec.write(args.trace_out)
+                summ = trace_rec.summary()
+                Logger.log(
+                    f"Telemetry trace written to {args.trace_out} "
+                    f"({summ['spans']} spans, {summ['counter_samples']} counter "
+                    f"samples, {summ['quality_rows']} quality rows)"
+                )
+            except OSError as exc:
+                # A failed trace write must neither void a finished
+                # partition nor mask the run's own exception.
+                Logger.warning(f"could not write trace {args.trace_out}: {exc}")
 
     p_graph = solver.last_partition
     Logger.log(

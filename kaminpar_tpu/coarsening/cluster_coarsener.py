@@ -212,6 +212,23 @@ class ClusterCoarsener:
                 from .sparsifier import sparsify_threshold
 
                 coarse = sparsify_threshold(coarse, target_m)
+        # Per-level quality row (ISSUE 5): every value below came out of the
+        # level's single batched readback — recording it adds zero blocking
+        # transfers (asserted with telemetry armed in tests/test_sync_stats).
+        from ..telemetry import probes
+
+        probes.coarsening_level(
+            level=len(self.hierarchy), n=graph.n, m=graph.m,
+            n_c=coarse.n, m_c=coarse.m, max_cluster_weight=max_cw,
+            # Cached values only (seeded by the contraction readback; a
+            # sparsified graph may lack them) — a probe must never sync.
+            max_node_weight=coarse._max_node_weight,
+            total_edge_weight=coarse._total_edge_weight,
+            lp_moved=lp_moved,
+            lp_rounds_budget=getattr(
+                getattr(clusterer, "ctx", None), "num_iterations", None
+            ),
+        )
         shrink = 1.0 - coarse.n / max(graph.n, 1)
         Logger.log(
             f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "

@@ -49,6 +49,21 @@ def edge_cut(graph: CSRGraph, partition) -> int:
     return int(_edge_cut(pv.edge_u, pv.col_idx, pv.edge_w, part))
 
 
+def edge_cut_device(pv, padded_labels):
+    """Device edge-cut scalar over a :class:`PaddedView` (no readback —
+    telemetry probes pack this into an existing pull)."""
+    return _edge_cut(pv.edge_u, pv.col_idx, pv.edge_w, padded_labels)
+
+
+def quality_scalars_device(pv, padded_labels, k: int):
+    """Device ``(cut, max_block_weight)`` pair for the per-level quality
+    probes (telemetry/probes.py).  Both stay on device so they can ride an
+    existing batched readback instead of costing their own transfers."""
+    cut = _edge_cut(pv.edge_u, pv.col_idx, pv.edge_w, padded_labels)
+    bw = _block_weights(padded_labels, pv.node_w, int(k))
+    return cut, jnp.max(bw)
+
+
 def imbalance(graph: CSRGraph, partition, k: int) -> float:
     """max_b w(b) / ceil(W/k) - 1 (reference: ``metrics::imbalance``)."""
     bw = np.asarray(block_weights(graph, partition, k))

@@ -235,6 +235,16 @@ def contract_clustering(
     coarse._max_node_weight = int(stats_np[2])
     coarse._total_edge_weight = int(stats_np[3])
     coarse._deg_hist = stats_np[4:STATS_LEN].astype(int)
+    # Telemetry counter sample from the values THIS pull already produced —
+    # the per-level quality probes ride the level's one readback (ISSUE 5);
+    # no-op when no trace recorder is active.
+    from ..telemetry import probes
+
+    probes.contraction_level(
+        n=graph.n, m=graph.m, n_c=n_c, m_c=m_c,
+        max_node_weight=coarse._max_node_weight,
+        total_edge_weight=coarse._total_edge_weight,
+    )
     out = (coarse, coarse_of[: graph.n])
     if extra_scalars:
         return out + (tuple(int(x) for x in stats_np[STATS_LEN:]),)

@@ -23,6 +23,7 @@ from ..initial.bipartitioner import (
     recursive_bipartition,
     resolve_ip_backend,
 )
+from ..telemetry import probes
 from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
@@ -359,9 +360,16 @@ class DeepMultilevelPartitioner:
                 target_k = compute_k_for_n(graph.n, C, k) if coarsener.num_levels > 0 else k
                 if cur_k < target_k:
                     with scoped_timer("extend_partition"):
+                        # The level's quality probe (cut + max block weight)
+                        # rides THIS pull — the spine's one existing
+                        # per-level partition readback — as two packed ints;
+                        # the transfer count is unchanged (ISSUE 5).
                         part = extend_partition(
-                            graph, sync_stats.pull(p_graph.partition), cur_k,
-                            target_k, ctx,
+                            graph,
+                            probes.pull_partition_with_quality(
+                                p_graph, level=coarsener.num_levels
+                            ),
+                            cur_k, target_k, ctx,
                         )
                     if debug:
                         from ..graph import metrics as _m

@@ -105,6 +105,8 @@ class KWayMultilevelPartitioner:
             refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
             p_graph = refiner.refine(p_graph)
 
+            from ..telemetry import probes
+
             while coarsener.num_levels > 0:
                 fine_part = coarsener.uncoarsen(p_graph.partition)
                 fine_graph = coarsener.current_graph
@@ -114,5 +116,12 @@ class KWayMultilevelPartitioner:
                 )
                 refiner = create_refiner(ctx, coarse_level=coarsener.num_levels > 0)
                 p_graph = refiner.refine(p_graph)
+                # Zero-transfer level marker (sizes are host-known; the
+                # refiners' own probes carry moved counts/cut when their
+                # existing pulls run).
+                probes.uncoarsening_level(
+                    level=coarsener.num_levels, n=fine_graph.n,
+                    m=fine_graph.m, k=k, kind="kway_level",
+                )
 
         return p_graph

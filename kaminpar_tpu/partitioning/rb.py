@@ -42,6 +42,12 @@ class RBMultilevelPartitioner:
         k1 = k - k0
         budgets = np.array([max_bw[:k0].sum(), max_bw[k0:].sum()], dtype=np.int64)
         bi = self._bisect(graph, budgets)
+        # Zero-transfer probe: one row per recursive bisection (sizes and
+        # split arity are host-known; each bisection's internal multilevel
+        # run records its own coarsening/refinement rows).
+        from ..telemetry import probes
+
+        probes.refinement_pass("rb_bisection", n=graph.n, m=graph.m, k0=k0, k1=k1)
         part = np.zeros(graph.n, dtype=np.int32)
         # One counted packed pull (round-9 stray-sync audit) instead of four
         # uncounted np.asarray transfers of the device arrays.

@@ -21,7 +21,6 @@ import numpy as np
 from ..context import Context
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
-from ..utils import sync_stats
 from ..utils.logger import Logger, OutputLevel
 from .deep import DeepMultilevelPartitioner
 from .partition_utils import intermediate_block_weights, split_offsets
@@ -59,7 +58,9 @@ class VcycleDeepMultilevelPartitioner:
         p_graph = None
         import copy
 
-        for step_k in steps:
+        from ..telemetry import probes
+
+        for cycle, step_k in enumerate(steps):
             cycle_ctx = copy.deepcopy(ctx)
             cycle_ctx.partition.k = step_k
             cycle_ctx.partition.max_block_weights = intermediate_block_weights(
@@ -79,8 +80,12 @@ class VcycleDeepMultilevelPartitioner:
             )
             p_graph = partitioner.partition()
             # One counted pull per cycle: the next cycle's community labels
-            # are host inputs to its coarsener construction.
-            communities = sync_stats.pull(p_graph.partition)
+            # are host inputs to its coarsener construction.  With telemetry
+            # armed the cycle's cut/imbalance probe rides this same pull
+            # (packed scalars; the transfer count is unchanged).
+            communities = probes.pull_partition_with_quality(
+                p_graph, level=cycle, kind="vcycle_quality"
+            )
             communities_k = step_k
 
         return PartitionedGraph.create(

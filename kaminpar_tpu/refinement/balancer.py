@@ -256,12 +256,18 @@ class UnderloadBalancer(Refiner):
         min_bw = jnp.asarray(p_graph.min_block_weights, dtype=pv.node_w.dtype)
         labels = pv.pad_node_array(p_graph.partition, 0)
         with scoped_timer("underload_balancer"):
-            for _ in range(self.ctx.max_num_rounds):
+            from ..telemetry import probes
+
+            for rnd in range(self.ctx.max_num_rounds):
                 labels, flags = _underload_round(
                     next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
                     pv.node_w, max_bw, min_bw, k=p_graph.k,
                 )
                 num_moved, still = sync_stats.pull(flags)
+                # Quality probe from the round's existing packed pull.
+                probes.refinement_round(
+                    "underload_balancer", round_idx=rnd, moved=int(num_moved)
+                )
                 if not still or num_moved == 0:
                     break
         return p_graph.with_partition(labels[: pv.n])
@@ -277,12 +283,18 @@ class OverloadBalancer(Refiner):
         max_bw = jnp.asarray(p_graph.max_block_weights, dtype=pv.node_w.dtype)
         labels = pv.pad_node_array(p_graph.partition, 0)
         with scoped_timer("overload_balancer"):
-            for _ in range(self.ctx.max_num_rounds):
+            from ..telemetry import probes
+
+            for rnd in range(self.ctx.max_num_rounds):
                 labels, flags = _balance_round(
                     next_key(), labels, bv.buckets, bv.heavy, bv.gather_idx,
                     pv.node_w, max_bw, k=p_graph.k,
                 )
                 num_moved, still = sync_stats.pull(flags)
+                # Quality probe from the round's existing packed pull.
+                probes.refinement_round(
+                    "overload_balancer", round_idx=rnd, moved=int(num_moved)
+                )
                 if not still:
                     break
                 if num_moved == 0:

@@ -66,6 +66,9 @@ class CLPRefiner(Refiner):
             # once per graph bucket, not once per color count; pad keys
             # repeat key 0 and are never consumed (fori stops at nc).
             nc_pad = next_pow2(nc, 4)
+            from ..telemetry import probes, trace as ttrace
+
+            rec = ttrace.active()
             for it in range(self.ctx.num_iterations):
                 # One next_key() per superstep, drawn in the exact order of
                 # the pre-fusion dispatch-per-superstep loop.
@@ -78,8 +81,28 @@ class CLPRefiner(Refiner):
                     allow_tie_moves=self.ctx.allow_tie_moves,
                 )
                 # One batched readback per iteration (the supersteps'
-                # moved counts are summed on device).
-                if int(sync_stats.pull(state.num_moved)) == 0:
+                # moved counts are summed on device).  With telemetry armed
+                # the round's cut rides the SAME pull (packed pair) — the
+                # per-round quality probe costs zero extra transfers.
+                if rec is not None:
+                    from ..graph import metrics as _metrics
+
+                    # The cast is exact: cut <= total edge weight < 2^31 in
+                    # the 32-bit build (repo-wide invariant, ops/contraction
+                    # .py); the 64-bit build carries int64 throughout.
+                    cut_dev = _metrics.edge_cut_device(pv, state.labels)
+                    pair = sync_stats.pull(
+                        jnp.stack([state.num_moved, cut_dev.astype(
+                            state.num_moved.dtype)])
+                    )
+                    moved = int(pair[0])
+                    probes.refinement_round(
+                        "clp_refinement", round_idx=it, moved=moved,
+                        cut=int(pair[1]),
+                    )
+                else:
+                    moved = int(sync_stats.pull(state.num_moved))
+                if moved == 0:
                     break
             # Tie diffusion can wander; keep the better of (input, refined).
             out = p_graph.with_partition(state.labels[: pv.n])

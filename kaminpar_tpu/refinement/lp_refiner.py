@@ -61,4 +61,14 @@ class LPRefiner(Refiner):
                 allow_tie_moves=self.ctx.allow_tie_moves,
             )
             ts.note(state.labels)
+            # Zero-transfer pass marker: moved count and cut deliberately
+            # stay on device here (this refiner's contract is zero
+            # readbacks); the sizes are the host-known record, and the
+            # spine's next existing pull carries the level's cut
+            # (telemetry/probes.pull_partition_with_quality).
+            from ..telemetry import probes
+
+            probes.refinement_pass(
+                "lp_refinement", n=pv.n, k=k, rounds_budget=self.ctx.num_iterations
+            )
         return p_graph.with_partition(state.labels[: pv.n])

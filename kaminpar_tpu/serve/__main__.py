@@ -10,6 +10,11 @@ Three modes:
 * ``--demo N`` (default when no graphs are given): run a synthetic
   burst workload of N RMAT requests across the warm ladder and print the
   stats snapshot — the quickest way to see batching/queueing behave.
+
+Observability (ISSUE 5): ``--metrics-port P`` serves the engine's Prometheus
+text exposition at ``http://127.0.0.1:P/metrics`` for the session's
+duration; ``--trace-out FILE`` records the whole session (engine queue
+lifecycle events + pipeline spans + quality probes) as a Chrome trace.
 """
 
 from __future__ import annotations
@@ -50,7 +55,43 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--demo-edge-factor", type=int, default=8)
     p.add_argument("-o", "--output", action="store_true",
                    help="write <graph>.part next to each served graph file")
+    p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
+                   help="serve Prometheus metrics at "
+                        "http://127.0.0.1:PORT/metrics (0 = off)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome trace-event JSON of the session")
     return p
+
+
+def _start_metrics_server(engine, port: int):
+    """Serve ``engine.metrics_text()`` at /metrics on a daemon thread;
+    returns the server (caller shuts it down)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.split("?")[0].rstrip("/") in ("", "/metrics"):
+                body = engine.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # silence per-scrape stderr noise
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(
+        target=server.serve_forever, name="kaminpar-serve-metrics", daemon=True
+    ).start()
+    return server
 
 
 def main(argv=None) -> int:
@@ -75,8 +116,21 @@ def main(argv=None) -> int:
         if val is not None:
             overrides[knob] = val
     engine = PartitionEngine(ctx, **overrides)
-    engine.start(warmup=not args.no_warmup)
+    from ..telemetry import trace as ttrace
+
+    rec = None
+    if args.trace_out:
+        rec = ttrace.start()
+        rec.meta.update({"mode": "serve", "preset": args.preset})
+    metrics_server = None
     try:
+        # Inside the try: a failed warmup or an already-bound metrics port
+        # must still drain/shut the engine and write the requested trace.
+        engine.start(warmup=not args.no_warmup)
+        if args.metrics_port:
+            metrics_server = _start_metrics_server(engine, args.metrics_port)
+            print(f"metrics: http://127.0.0.1:{args.metrics_port}/metrics",
+                  file=sys.stderr)
         if args.warmup_only:
             print(json.dumps({"warmup": engine.warmup_report,
                               "stats": engine.stats()}, default=str))
@@ -117,7 +171,24 @@ def main(argv=None) -> int:
         print(json.dumps(engine.stats(), default=str))
         return 0
     finally:
-        engine.shutdown(drain=True)
+        try:
+            engine.shutdown(drain=True)
+        finally:
+            # A failed/interrupted drain must still stop the metrics server
+            # and write the requested trace.
+            if metrics_server is not None:
+                metrics_server.shutdown()
+            if rec is not None:
+                ttrace.stop()
+                try:
+                    rec.write(args.trace_out)
+                    print(f"trace written to {args.trace_out} "
+                          f"({rec.summary()['events']} events)", file=sys.stderr)
+                except OSError as exc:
+                    # A failed trace write must neither mask the session's
+                    # own exception nor crash a finished session at exit.
+                    print(f"warning: could not write trace {args.trace_out}: "
+                          f"{exc}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -408,16 +408,28 @@ class PartitionEngine:
             min_block_weights=min_block_weights,
         )
         req.future.request_id = req.id
+        from ..telemetry import trace as ttrace
+
+        rec = ttrace.active()
         try:
             self._queue.put(req)
         except QueueFullError:
             self.stats_.bump("rejected_full")
-            raise QueueFullError(
-                self.stats_.retry_after_estimate(
-                    len(self._queue), self.serve.max_batch
-                )
-            ) from None
+            retry_after = self.stats_.retry_after_estimate(
+                len(self._queue), self.serve.max_batch
+            )
+            if rec is not None:
+                rec.instant("serve.reject", request_id=req.id,
+                            retry_after_s=round(retry_after, 3))
+            raise QueueFullError(retry_after) from None
         self.stats_.bump("admitted")
+        if rec is not None:
+            # Queue lifecycle point: admission (the matching dispatch/resolve
+            # events come from the dispatcher thread's batch span).
+            rec.instant("serve.admit", request_id=req.id, k=req.k,
+                        n_bucket=cell.n_bucket, m_bucket=cell.m_bucket,
+                        warm_hit=warm)
+            rec.counter("serve.queue", {"depth": len(self._queue)})
         return req.future
 
     def partition(
@@ -488,7 +500,22 @@ class PartitionEngine:
         if not live:
             return
         self.stats_.record_batch(len(live))
+        from ..telemetry import trace as ttrace
 
+        rec = ttrace.active()
+        if rec is not None:
+            cell = live[0].cell
+            rec.begin("serve.batch", occupancy=len(live), k=cell.k,
+                      n_bucket=cell.n_bucket, m_bucket=cell.m_bucket)
+
+        try:
+            self._execute_live(live)
+        finally:
+            if rec is not None:
+                rec.end("serve.batch")
+                rec.counter("serve.queue", {"depth": len(self._queue)})
+
+    def _execute_live(self, live: List[ServeRequest]) -> None:
         ok: List[ServeRequest] = []
         for req in live:
             # Queue wait runs until THIS request's execution starts, so a
@@ -530,6 +557,9 @@ class PartitionEngine:
             pad_to=self.serve.max_batch,
         )
         metrics_share_s = (time.perf_counter() - t_metrics) / len(ok)
+        from ..telemetry import trace as ttrace
+
+        rec = ttrace.active()
         for i, req in enumerate(ok):
             req.execute_s += metrics_share_s
             self._note_warm(req.cell)
@@ -545,6 +575,13 @@ class PartitionEngine:
                 warm_hit=req.warm_hit,
                 request_id=req.id,
             ))
+            if rec is not None:
+                rec.instant(
+                    "serve.resolve", request_id=req.id, cut=int(cuts[i]),
+                    feasible=feasible,
+                    queue_wait_ms=round(req.queue_wait_s * 1e3, 2),
+                    execute_ms=round(req.execute_s * 1e3, 2),
+                )
 
     # -- observability -----------------------------------------------------
 
@@ -557,3 +594,20 @@ class PartitionEngine:
         snap["warm_cells"] = len(self._warm_cells)
         snap["warmup"] = list(self.warmup_report)
         return snap
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the serving metrics (ISSUE 5):
+        queue depth, admission/reject/timeout counts, batch occupancy,
+        warm-cache hit rate, p50/p90/p99 latencies, and the compile-shape /
+        blocking-transfer censuses.  The serve CLI's ``--metrics-port``
+        serves this at ``/metrics``; scrape-friendly and dependency-free
+        (telemetry/prometheus.py)."""
+        from ..telemetry import prometheus
+
+        return prometheus.render(
+            self.stats_.prometheus_families(
+                queue_depth=len(self._queue),
+                running=self._running,
+                warm_cells=len(self._warm_cells),
+            )
+        )

@@ -144,3 +144,77 @@ class ServeStats:
         out["host_sync_count"] = sync_snap["count"]
         out["host_sync_bytes"] = sync_snap["bytes"]
         return out
+
+    def prometheus_families(
+        self,
+        queue_depth: Optional[int] = None,
+        running: Optional[bool] = None,
+        warm_cells: Optional[int] = None,
+    ) -> list:
+        """The snapshot as Prometheus metric families (ISSUE 5):
+        ``[(name, type, help, [(labels, value), ...]), ...]`` rendered by
+        :mod:`kaminpar_tpu.telemetry.prometheus` into
+        ``PartitionEngine.metrics_text()`` / the serve CLI's ``/metrics``
+        endpoint."""
+        snap = self.snapshot(queue_depth=queue_depth)
+        outcome_counters = (
+            "submitted", "admitted", "rejected_full", "timed_out",
+            "cancelled", "completed", "failed",
+        )
+        lat_samples = []
+        count_samples = []
+        for stage, summary in snap["latency_ms"].items():
+            base = stage[:-3] if stage.endswith("_ms") else stage
+            count_samples.append(({"stage": base}, summary.get("count", 0)))
+            for quantile, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if key in summary:
+                    lat_samples.append(
+                        ({"stage": base, "quantile": quantile}, summary[key])
+                    )
+        return [
+            ("kaminpar_serve_queue_depth", "gauge",
+             "Requests currently waiting in the bounded queue",
+             [({}, snap.get("queue_depth"))]),
+            ("kaminpar_serve_requests_total", "counter",
+             "Requests by admission/completion outcome",
+             [({"outcome": name}, snap[name]) for name in outcome_counters]),
+            ("kaminpar_serve_warm_lookups_total", "counter",
+             "Warm-cache lookups by result",
+             [({"result": "hit"}, snap["warm_hits"]),
+              ({"result": "miss"}, snap["warm_misses"])]),
+            ("kaminpar_serve_warm_hit_rate", "gauge",
+             "Fraction of submissions landing in a warmed shape cell",
+             [({}, snap["warm_hit_rate"])]),
+            ("kaminpar_serve_batches_total", "counter",
+             "Micro-batches dispatched",
+             [({}, snap["batches"])]),
+            ("kaminpar_serve_batch_occupancy", "gauge",
+             "Requests per dispatched micro-batch",
+             [({"stat": "mean"}, snap["batch_occupancy_mean"]),
+              ({"stat": "max"}, snap["batch_occupancy_max"])]),
+            ("kaminpar_serve_latency_ms", "gauge",
+             "Latency percentiles in milliseconds over the rolling reservoir",
+             lat_samples),
+            ("kaminpar_serve_latency_samples", "gauge",
+             "Total latency samples recorded per stage (the percentile "
+             "reservoir keeps only the most recent window)",
+             count_samples),
+            ("kaminpar_serve_ema_service_seconds", "gauge",
+             "Smoothed per-request service time feeding retry-after estimates",
+             [({}, snap["ema_service_s"])]),
+            ("kaminpar_serve_host_sync_transfers_total", "counter",
+             "Blocking device-to-host transfers (process-wide census)",
+             [({}, snap["host_sync_count"])]),
+            ("kaminpar_serve_host_sync_bytes_total", "counter",
+             "Bytes moved by blocking device-to-host transfers (process-wide)",
+             [({}, snap["host_sync_bytes"])]),
+            ("kaminpar_serve_compiled_shapes", "gauge",
+             "Distinct compiled kernel specializations (process-wide census)",
+             [({}, snap["compiled_shape_count"].get("total", 0))]),
+            ("kaminpar_serve_running", "gauge",
+             "Whether the engine dispatcher is accepting work",
+             [({}, None if running is None else int(bool(running)))]),
+            ("kaminpar_serve_warm_cells", "gauge",
+             "Distinct (n-bucket, m-bucket, k) cells warmed so far",
+             [({}, warm_cells)]),
+        ]

@@ -1,0 +1,59 @@
+"""Unified run telemetry (ISSUE 5).
+
+One subsystem over the four instrument layers that grew separately — the
+timer tree (utils/timer.py), the blocking-transfer census
+(utils/sync_stats.py), the compiled-shape census (utils/compile_stats.py),
+and the serve stats (serve/stats.py):
+
+- :mod:`.trace` — the per-run structured event trace (spans, counter
+  samples, quality rows) with Chrome trace-event / Perfetto JSON export and
+  optional ``jax.profiler`` arming around configured phases.
+- :mod:`.probes` — per-level quality probes that ride *existing* batched
+  readbacks (zero additional blocking transfers).
+- :mod:`.phases` — the canonical phase-name registry shared by the timer,
+  the sync budget, and the trace.
+- :mod:`.prometheus` — text-exposition rendering for the serve engine's
+  ``metrics_text()`` / ``/metrics`` endpoint.
+
+Typical use::
+
+    from kaminpar_tpu import telemetry
+
+    with telemetry.run(trace_out="trace.json") as rec:
+        solver.compute_partition(k=64)
+    # rec.quality -> per-level rows; trace.json opens in chrome://tracing
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import phases, trace
+from .trace import TraceRecorder, active, run, start, stop, validate_chrome_trace
+
+
+@dataclass
+class TelemetryContext:
+    """Run-telemetry knobs (constructed by the CLI / bench drivers).
+
+    ``profile_phases`` names timer phases around which the recorder arms a
+    ``jax.profiler`` capture (one capture at a time, outermost armed phase
+    wins), so the exported trace and the XLA profile cover the same window.
+    """
+
+    trace_out: str = ""
+    profile_phases: tuple = field(default_factory=tuple)
+    profile_dir: str = ""
+
+
+__all__ = [
+    "TelemetryContext",
+    "TraceRecorder",
+    "active",
+    "phases",
+    "run",
+    "start",
+    "stop",
+    "trace",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,76 @@
+"""Canonical phase-name registry (ISSUE 5 satellite).
+
+One list of phase names shared by the timer tree (``utils/timer.scoped_timer``
+pushes these as sync-accounting phases), :mod:`utils.sync_stats` (budget
+assertions key on them), and the telemetry trace (spans and per-level quality
+probes carry them).  Before this registry existed a misspelled phase name
+silently escaped the sync budget: a budget assertion against a typo'd phase
+counts a phase nobody ever pushed and trivially passes.  Now
+
+- :func:`check` warns (once per process per name) when a scope opens under an
+  unregistered name, and
+- a tier-1 test (tests/test_telemetry.py) statically scans the source tree
+  for phase-name literals and fails on any drift in either direction.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+# The partitioning spine's phases — every scoped_timer scope in the library
+# uses one of these names (reference: the timer-tree keys of
+# kaminpar-shm/kaminpar.cc's TIME lines).
+CORE_PHASES = (
+    "partitioning",
+    "coarsening",
+    "lp_clustering",
+    "hem_clustering",
+    "initial_partitioning",
+    "extend_partition",
+    "uncoarsening",
+    "lp_refinement",
+    "clp_refinement",
+    "fm_refinement",
+    "jet_refinement",
+    "overload_balancer",
+    "underload_balancer",
+    # distributed tier (dist/partitioner.py)
+    "dist_coarsening",
+    "dist_initial_partitioning",
+    "dist_uncoarsening",
+)
+
+# Phases pushed outside the spine: serve-runtime internals and the bench
+# driver's measurement fences.
+AUX_PHASES = (
+    "serve_batch_metrics",  # serve/batching.py packed-metrics readback
+    "lp_bench_fence",       # bench.py microbench sync fences
+    "untracked",            # sync_stats' default phase for unscoped pulls
+)
+
+KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)
+
+_warned: set = set()
+
+
+def is_known(name: str) -> bool:
+    return name in KNOWN_PHASES
+
+
+def check(name: str) -> bool:
+    """Warn once per process about an unregistered phase name (tests and
+    ad-hoc scopes are allowed to use arbitrary names — the warning exists so
+    a misspelled *library* phase cannot silently escape the sync budget;
+    library-side drift additionally fails the static registry test)."""
+    if name in KNOWN_PHASES:
+        return True
+    if name not in _warned:
+        _warned.add(name)
+        warnings.warn(
+            f"kaminpar_tpu: timer phase {name!r} is not in the canonical "
+            "phase registry (kaminpar_tpu/telemetry/phases.py) — sync-budget "
+            "assertions and telemetry dashboards key on registered names",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return False

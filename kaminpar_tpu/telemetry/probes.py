@@ -1,0 +1,159 @@
+"""Per-level quality probes (ISSUE 5 tentpole).
+
+The deep-multilevel paper (Gottesbüren et al., ESA'21) argues convergence
+with per-level cut/imbalance tables; the reference prints per-level
+statistics from host-resident graphs where reading ``cut`` is free.  On the
+device-resident spine every scalar readback is a blocking transfer the
+one-readback-per-level contract forbids, so these probes follow one rule:
+
+    **a quality probe never adds a blocking device->host transfer** —
+    it either records host values that an existing batched readback already
+    produced (the contraction stats pull, the CLP per-iteration moved-count
+    pull, the balancer round pull), or it *packs* extra device scalars into
+    an existing pull (``pull_partition_with_quality`` widens the
+    extend-partition readback by two ints).
+
+The existing ``sync_stats.assert_phase_budget`` checks therefore pass
+unchanged with telemetry armed (asserted in tests/test_sync_stats.py and
+tests/test_telemetry.py).  Every probe is a no-op (one attribute load) when
+no telemetry run is active.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import trace
+
+
+def _rec() -> Optional[trace.TraceRecorder]:
+    return trace.active()
+
+
+def contraction_level(*, n, m, n_c, m_c, max_node_weight, total_edge_weight) -> None:
+    """Counter sample emitted by ``ops/contraction.contract_clustering`` from
+    the values its single batched stats readback already pulled."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.counter("contraction", {
+        "n": int(n), "m": int(m), "n_c": int(n_c), "m_c": int(m_c),
+        "max_node_weight": int(max_node_weight),
+        "total_edge_weight": int(total_edge_weight),
+    })
+
+
+def coarsening_level(*, level, n, m, n_c, m_c, max_cluster_weight,
+                     max_node_weight, total_edge_weight,
+                     lp_moved=None, lp_rounds_budget=None) -> None:
+    """The coarsener's per-level quality row: sizes, shrink, the LP moved
+    count — all host values from the level's one batched readback."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.quality_row(
+        "coarsening_level",
+        level=int(level), n=int(n), m=int(m), n_c=int(n_c), m_c=int(m_c),
+        shrink=round(1.0 - n_c / max(n, 1), 4),
+        max_cluster_weight=int(max_cluster_weight),
+        max_node_weight=int(max_node_weight) if max_node_weight is not None else None,
+        total_edge_weight=(
+            int(total_edge_weight) if total_edge_weight is not None else None
+        ),
+        lp_moved=int(lp_moved) if lp_moved is not None else None,
+        lp_rounds_budget=(
+            int(lp_rounds_budget) if lp_rounds_budget is not None else None
+        ),
+    )
+
+
+def refinement_round(phase: str, *, round_idx, moved, cut=None) -> None:
+    """One refiner round whose moved count (and, when packed, cut) already
+    rode an existing readback (CLP per-iteration pull, balancer round pull)."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.quality_row(phase, round_idx=int(round_idx), moved=int(moved),
+                    cut=int(cut) if cut is not None else None)
+
+
+def refinement_pass(phase: str, **values) -> None:
+    """Marker row for a refinement pass whose state stays fully on device
+    (the LP refiner performs zero readbacks; its moved count and cut are
+    deliberately NOT pulled — the span + host-known sizes are the record)."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.quality_row(phase, **{k: int(v) for k, v in values.items()})
+
+
+def uncoarsening_level(*, level, n, m, k, cut=None, max_block_weight=None,
+                       total_node_weight=None, kind="level_quality") -> None:
+    """Per-level quality row on the way up: cut and imbalance of the refined
+    partition at this level (values packed into an existing pull)."""
+    rec = _rec()
+    if rec is None:
+        return
+    imbalance = None
+    if (
+        max_block_weight is not None
+        and total_node_weight
+        and k > 0
+    ):
+        perfect = -(int(total_node_weight) // -int(k))  # ceil(W/k)
+        if perfect > 0:
+            imbalance = round(int(max_block_weight) / perfect - 1.0, 6)
+    rec.quality_row(
+        kind,
+        level=int(level), n=int(n), m=int(m), k=int(k),
+        cut=int(cut) if cut is not None else None,
+        max_block_weight=(
+            int(max_block_weight) if max_block_weight is not None else None
+        ),
+        imbalance=imbalance,
+    )
+
+
+def pull_partition_with_quality(p_graph, *, level, kind="level_quality"):
+    """Pull a partition to the host — the spine's existing per-level
+    readback — and, when telemetry is armed, let the level's cut and max
+    block weight ride the SAME single pull (packed into one array; the
+    transfer count is identical either way).
+
+    Returns the (n,) host partition array, exactly like
+    ``sync_stats.pull(p_graph.partition)`` does.
+    """
+    from ..utils import sync_stats
+
+    part = p_graph.partition
+    rec = _rec()
+    if rec is None:
+        return sync_stats.pull(part)
+
+    import jax.numpy as jnp
+
+    from ..graph import metrics
+
+    graph = p_graph.graph
+    pv = graph.padded()
+    part = jnp.asarray(part)
+    padded = pv.pad_node_array(part, 0)
+    cut, bw_max = metrics.quality_scalars_device(pv, padded, int(p_graph.k))
+    # Packing into the partition's dtype is exact under the repo-wide weight
+    # invariant (ops/contraction.py): total node/edge weight stays below
+    # 2^31 in the 32-bit build (cut <= total edge weight, max block weight
+    # <= total node weight), and the 64-bit build carries int64 end to end.
+    packed = jnp.concatenate(
+        [part, jnp.stack([cut, bw_max]).astype(part.dtype)]
+    )
+    host = sync_stats.pull(packed)  # still ONE blocking transfer
+    part_host, cut_v, bw_v = host[:-2], int(host[-2]), int(host[-1])
+    uncoarsening_level(
+        level=level, n=graph.n, m=graph.m, k=int(p_graph.k),
+        cut=cut_v, max_block_weight=bw_v,
+        # Only a cached total weight is used — reading the property could
+        # itself sync, which a probe must never do.
+        total_node_weight=graph._total_node_weight,
+        kind=kind,
+    )
+    return part_host

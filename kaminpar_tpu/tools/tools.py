@@ -171,6 +171,50 @@ def warmup(argv) -> int:
     return 0
 
 
+def trace(argv) -> int:
+    """Validate, summarize, and optionally re-emit a telemetry trace
+    (ISSUE 5; no reference counterpart — the reference prints TIME lines).
+    The input is a Chrome trace-event JSON produced by ``--trace-out``;
+    validation enforces what Perfetto/chrome://tracing require (monotonic
+    per-thread timestamps, matched B/E pairs, numeric counter args).
+    ``--out`` re-emits the validated trace (a load/validate/dump round
+    trip), ``--quality`` prints the embedded per-level quality rows."""
+    import json
+
+    p = argparse.ArgumentParser(prog="trace")
+    p.add_argument("trace", help="Chrome trace-event JSON (from --trace-out)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="re-emit the validated trace to this path")
+    p.add_argument("--quality", action="store_true",
+                   help="print the per-level quality rows as JSON lines")
+    args = p.parse_args(argv)
+    from ..telemetry.trace import validate_chrome_trace
+
+    with open(args.trace) as fh:
+        obj = json.load(fh)
+    try:
+        summary = validate_chrome_trace(obj)
+    except ValueError as exc:
+        print(f"error: invalid trace: {exc}")
+        return 1
+    other = obj.get("otherData") or {}
+    print(f"Trace: {args.trace}")
+    print(f"  events: {summary['events']} (spans {summary['spans']}, "
+          f"counters {summary['counters']}, instants {summary['instants']})")
+    print(f"  duration: {summary['duration_us'] / 1e6:.3f} s")
+    print(f"  span names: {', '.join(summary['span_names']) or '(none)'}")
+    print(f"  counter tracks: {', '.join(summary['counter_names']) or '(none)'}")
+    print(f"  quality rows: {summary['quality_rows']}")
+    if args.quality:
+        for row in other.get("quality", []):
+            print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(obj, fh)
+        print(f"re-emitted {summary['events']} events to {args.out}")
+    return 0
+
+
 REGISTRY = {
     "graph-properties": graph_properties,
     "partition-properties": partition_properties,
@@ -178,4 +222,5 @@ REGISTRY = {
     "rearrange": rearrange,
     "compression": compression,
     "warmup": warmup,
+    "trace": trace,
 }

@@ -20,6 +20,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from ..telemetry import trace as _ttrace
+
 _lock = threading.Lock()
 _shapes: dict = defaultdict(set)
 _compile_secs = {"backend_compile_s": 0.0, "trace_s": 0.0, "compile_events": 0}
@@ -41,7 +43,16 @@ def record(kind: str, arrays=(), statics=()) -> None:
     Python there runs once per compile, never per execution."""
     sig = _sig_of(arrays, statics)
     with _lock:
+        new = sig not in _shapes[kind]
         _shapes[kind].add(sig)
+        total = sum(len(v) for v in _shapes.values())
+    # Telemetry counter sample only when the specialization is NEW — record()
+    # re-fires on retraces of known shapes, and those must not spam the
+    # trace; the track then shows exactly the cold-compile bursts.
+    if new:
+        rec = _ttrace.active()
+        if rec is not None:
+            rec.counter("compiled_shapes", {"total": total})
 
 
 def distinct(kind: str | None = None) -> int:

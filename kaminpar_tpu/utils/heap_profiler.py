@@ -12,6 +12,7 @@ builds) it degrades to a no-op with a single warning.
 from __future__ import annotations
 
 import contextlib
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -40,17 +41,48 @@ class HeapScope:
 
 
 class HeapProfiler:
-    """Singleton scoped profiler (mirrors the global heap profiler tree)."""
+    """Singleton scoped profiler (mirrors the global heap profiler tree).
+
+    Thread model (mirrors utils/timer.py): every thread records into its own
+    subtree — the resetting thread owns the primary root, other threads get
+    a lazily-created root listed in ``_subtrees`` — so concurrent
+    ``scoped_timer`` scopes from serve worker threads can never pop another
+    thread's stack.  ``report`` walks the primary tree plus each thread
+    subtree."""
 
     _root: Optional[HeapScope] = None
-    _stack: List[HeapScope] = []
+    _subtrees: List[HeapScope] = []
+    _tls = threading.local()
+    _root_owner: int = 0
+    _lock = threading.Lock()
     enabled: bool = False
 
     @classmethod
     def reset(cls, enabled: bool = True) -> None:
         cls._root = HeapScope("root")
-        cls._stack = [cls._root]
+        cls._subtrees = []
+        cls._root_owner = threading.get_ident()
+        # Forget every per-thread stack; a thread mid-scope keeps popping
+        # its orphaned (pre-reset) list, which is harmless.
+        cls._tls = threading.local()
+        cls._tls.stack = [cls._root]
         cls.enabled = enabled
+
+    @classmethod
+    def _stack(cls) -> List[HeapScope]:
+        stack = getattr(cls._tls, "stack", None)
+        if stack is None:
+            if threading.get_ident() == cls._root_owner:
+                stack = [cls._root]
+            else:
+                root = HeapScope(
+                    f"thread:{threading.current_thread().name or 'worker'}"
+                )
+                with cls._lock:
+                    cls._subtrees.append(root)
+                stack = [root]
+            cls._tls.stack = stack
+        return stack
 
     @classmethod
     @contextlib.contextmanager
@@ -58,17 +90,30 @@ class HeapProfiler:
         if not cls.enabled or cls._root is None:
             yield
             return
+        stack = cls._stack()
         stats = _device_stats()
         node = HeapScope(name, bytes_at_entry=(stats or {}).get("bytes_in_use", 0))
-        cls._stack[-1].children.append(node)
-        cls._stack.append(node)
+        stack[-1].children.append(node)
+        stack.append(node)
         try:
             yield
         finally:
             stats = _device_stats()
             node.bytes_at_exit = (stats or {}).get("bytes_in_use", 0)
             node.global_peak_at_exit = (stats or {}).get("peak_bytes_in_use", 0)
-            cls._stack.pop()
+            stack.pop()
+            if stats:
+                # Per-phase device-memory counter sample on the run trace
+                # (ISSUE 5 satellite): live bytes + the global HBM high-water
+                # mark at every scope boundary.
+                from ..telemetry import trace as _ttrace
+
+                rec = _ttrace.active()
+                if rec is not None:
+                    rec.counter("hbm_bytes", {
+                        "in_use": node.bytes_at_exit,
+                        "peak": node.global_peak_at_exit,
+                    })
 
     @classmethod
     def report(cls) -> str:
@@ -85,7 +130,8 @@ class HeapProfiler:
             )
 
         def walk(node: HeapScope, depth: int):
-            for ch in node.children:
+            # list(): an owning thread may append a sibling mid-report.
+            for ch in list(node.children):
                 lines.append(
                     "%s%s: entry=%d exit=%d (delta %+d, global peak %d)"
                     % (
@@ -97,6 +143,11 @@ class HeapProfiler:
                 walk(ch, depth + 1)
 
         walk(cls._root, 1)
+        with cls._lock:
+            subtrees = list(cls._subtrees)
+        for sub in subtrees:
+            lines.append(f"  {sub.name}:")
+            walk(sub, 2)
         return "\n".join(lines)
 
 
@@ -108,3 +159,18 @@ def memory_summary() -> Dict[str, int]:
         for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
         if k in stats
     }
+
+
+def watermark_report() -> Dict[str, object]:
+    """HBM watermark record for bench.py / the prober (ISSUE 5 satellite):
+    live bytes, the peak high-water mark, the allocator limit, and the peak's
+    fraction of it — the number to cross-check against the per-chip budgets
+    derived in HBM_BUDGET.md.  Empty values on backends without allocator
+    stats (most CPU builds) — the absence is the honest reading."""
+    out: Dict[str, object] = dict(memory_summary())
+    peak = out.get("peak_bytes_in_use")
+    limit = out.get("bytes_limit")
+    if peak is not None and limit:
+        out["peak_frac_of_limit"] = round(int(peak) / int(limit), 4)
+    out["budget_doc"] = "HBM_BUDGET.md"
+    return out

@@ -41,6 +41,8 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from ..telemetry import trace as _ttrace
+
 _lock = threading.Lock()
 # phase -> [explicit_count, explicit_bytes, implicit_count, implicit_bytes]
 _counts: Dict[str, list] = {}
@@ -86,6 +88,19 @@ def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None) -
             row = _counts[ph] = [0, 0, 0, 0]
         row[kind_offset] += count
         row[kind_offset + 1] += nbytes
+        total_count = sum(r[0] for r in _counts.values())
+        total_bytes = sum(r[1] for r in _counts.values())
+        total_implicit = sum(r[2] for r in _counts.values())
+    # Telemetry counter sample: the blocking-transfer census as a trace
+    # track, one sample per counted transfer (rare by contract — one batched
+    # readback per level).
+    rec = _ttrace.active()
+    if rec is not None:
+        rec.counter("host_sync", {
+            "count": total_count,
+            "bytes": total_bytes,
+            "implicit": total_implicit,
+        })
 
 
 def pull(*arrays, phase: str | None = None):

@@ -7,6 +7,20 @@ On TPU the device work is asynchronous, so scopes that wrap device computation
 should pass ``block=True`` (calls ``jax.block_until_ready`` on a sentinel) or
 time whole jitted calls; additionally each scope emits a
 ``jax.profiler.TraceAnnotation`` so timings line up with XLA traces.
+
+Thread model (ISSUE 5 satellite): every thread accumulates into its OWN
+subtree — the creating thread owns the primary root, any other thread gets a
+thread-local root lazily — and reports merge the subtrees by scope name at
+read time.  Before this, concurrent ``scoped_timer`` scopes from the serve
+engine's dispatcher/worker threads raced on one shared scope stack
+(pop-from-the-wrong-thread corrupted the tree); now a thread can never see
+another thread's stack.  Merging sums ``elapsed``/``starts`` per name, so
+single-threaded reports are byte-identical to the pre-merge behavior.
+
+Every scope also feeds the run telemetry (telemetry/trace.py) when a
+recorder is active: a span begin/end pair per scope, plus optional
+``jax.profiler`` arming for phases named in the recorder's
+``profile_phases``.
 """
 
 from __future__ import annotations
@@ -14,7 +28,10 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional
+
+from ..telemetry import phases as _phases
+from ..telemetry import trace as _ttrace
 
 
 class _TimerNode:
@@ -33,6 +50,15 @@ class _TimerNode:
         return node
 
 
+def _merge(dst: _TimerNode, src: _TimerNode) -> None:
+    dst.elapsed += src.elapsed
+    dst.starts += src.starts
+    # list(): src may belong to a live thread inserting children mid-merge;
+    # a racing insert is simply missed by this report, never a crash.
+    for name, child in list(src.children.items()):
+        _merge(dst.child(name), child)
+
+
 class Timer:
     """Global hierarchical timer (reference: ``Timer::global()``)."""
 
@@ -40,7 +66,11 @@ class Timer:
 
     def __init__(self, name: str = "root"):
         self._root = _TimerNode(name)
-        self._stack = [self._root]
+        self._tls = threading.local()
+        self._tls.stack = [self._root]  # binds for the creating thread only
+        # Other threads' lazily-created roots; merged into reports.
+        self._subtrees: List[_TimerNode] = []
+        self._subtree_lock = threading.Lock()
         self._disabled = 0  # depth counter: parallel sections nest
         self._disabled_lock = threading.Lock()  # += from pool workers races
         self._t0 = time.perf_counter()
@@ -63,19 +93,36 @@ class Timer:
         """Reference disables timers during parallel IP
         (deep_multilevel.cc:213); we disable during per-block host work.
         disable/enable nest as a depth counter: an inner parallel section's
-        re-enable must not reactivate the (thread-unsafe) scope stack while
-        an outer parallel section still has worker threads running."""
+        re-enable must not reactivate scope accounting while an outer
+        parallel section still has worker threads running."""
         with self._disabled_lock:
             self._disabled += 1
+
+    def _stack(self) -> list:
+        """This thread's scope stack (created on first use; non-creator
+        threads root in their own subtree)."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            root = _TimerNode(threading.current_thread().name or "thread")
+            with self._subtree_lock:
+                self._subtrees.append(root)
+            stack = self._tls.stack = [root]
+        return stack
 
     @contextmanager
     def scope(self, name: str):
         if self._disabled:
             yield
             return
-        node = self._stack[-1].child(name)
+        stack = self._stack()
+        node = stack[-1].child(name)
         node.starts += 1
-        self._stack.append(node)
+        stack.append(node)
+        rec = _ttrace.active()
+        armed = False
+        if rec is not None:
+            rec.begin(name)
+            armed = rec.arm_profiler(name)
         start = time.perf_counter()
         try:
             import jax
@@ -84,9 +131,40 @@ class Timer:
                 yield
         finally:
             node.elapsed += time.perf_counter() - start
-            self._stack.pop()
+            stack.pop()
+            if rec is not None:
+                if armed:
+                    rec.disarm_profiler()
+                rec.end(name)
 
     # -- reporting ---------------------------------------------------------
+
+    def merged_root(self) -> _TimerNode:
+        """One tree over every thread's subtree: per-name sums of
+        elapsed/starts.  Worker threads' *top-level* scopes merge as
+        top-level phases (they run the same phase names the main thread
+        would).  Reads race benignly with live scopes — a report taken
+        mid-scope simply misses the open scope's in-flight time."""
+        out = _TimerNode(self._root.name)
+        _merge(out, self._root)
+        with self._subtree_lock:
+            subtrees = list(self._subtrees)
+        for sub in subtrees:
+            # list(): the owning thread may insert a sibling scope mid-read.
+            for child in list(sub.children.values()):
+                _merge(out.child(child.name), child)
+        return out
+
+    def phase_seconds(self, *path: str) -> Optional[float]:
+        """Merged elapsed seconds of the scope at ``path`` (e.g.
+        ``phase_seconds("partitioning", "coarsening")``); None when the
+        scope never ran."""
+        node = self.merged_root()
+        for name in path:
+            node = node.children.get(name)
+            if node is None:
+                return None
+        return node.elapsed
 
     def _walk(self, node: _TimerNode, prefix: str, depth: int, max_depth: int, out: list):
         if depth > max_depth:
@@ -97,7 +175,7 @@ class Timer:
 
     def render(self, max_depth: int = 4) -> str:
         rows: list = []
-        for child in self._root.children.values():
+        for child in self.merged_root().children.values():
             self._walk(child, "", 0, max_depth, rows)
         lines = []
         for depth, name, elapsed, starts in rows:
@@ -107,7 +185,7 @@ class Timer:
     def machine_readable(self) -> str:
         """``TIME key=value`` line (reference: kaminpar.cc:50-68)."""
         rows: list = []
-        for child in self._root.children.values():
+        for child in self.merged_root().children.values():
             self._walk(child, "", 0, 99, rows)
         parts = []
         stack: list = []
@@ -157,14 +235,18 @@ def scoped_timer(name: str, sync: bool = False):
     heap_profiler.h macro APIs — the reference pairs them on every scope).
 
     Also pushes ``name`` as the active :mod:`utils.sync_stats` phase so
-    blocking-transfer counts line up with the timer tree.  ``sync=True``
-    marks a scope that ends with in-flight device work: the scope yields a
+    blocking-transfer counts line up with the timer tree, checks ``name``
+    against the canonical phase registry (telemetry/phases.py — a misspelled
+    phase warns instead of silently escaping the sync budget), and emits a
+    telemetry span when a trace recorder is active.  ``sync=True`` marks a
+    scope that ends with in-flight device work: the scope yields a
     :class:`SyncSentinel`, and when :func:`set_sync_mode` is on the scope
     calls ``jax.block_until_ready`` on the noted array before recording its
     elapsed time."""
     from . import sync_stats
     from .heap_profiler import HeapProfiler
 
+    _phases.check(name)
     sentinel = SyncSentinel()
     with Timer.global_().scope(name):
         with HeapProfiler.scope(name):

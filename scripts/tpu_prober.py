@@ -95,6 +95,13 @@ def child_attempt() -> None:
     # initial_partitioning_* keys land in the same salvaged record.
     os.environ.setdefault("KPTPU_BENCH_IP_AB", "1")
     os.environ.setdefault("KPTPU_BENCH_IP_SCALE", "12")
+    # Run telemetry (ISSUE 5): the full-partition phase records the unified
+    # trace on-silicon; its summary (trace path, per-level quality rows,
+    # HBM watermark) rides the salvaged record into TPU_RESULT.json and
+    # TPU_PROBE_LOG.jsonl.
+    os.environ.setdefault(
+        "KPTPU_BENCH_TRACE_OUT", os.path.join(REPO, "TPU_trace.json")
+    )
     from bench import run_benchmark, run_lp_phase
 
     run_benchmark()
@@ -186,13 +193,28 @@ def run_attempt(attempt: int) -> dict | None:
     if not outcome:
         outcome = {0: "measured", 3: "ambient_is_cpu", 4: "init_error"}.get(
             rc, f"child_rc_{rc}")
-    _log({
+    # The telemetry summary (trace path / quality rows / HBM watermark) of a
+    # measured attempt rides the per-attempt log record too, so the probe
+    # log is self-contained evidence even when TPU_RESULT.json moves on.
+    telemetry = next(
+        (r.get("telemetry") for r in reversed(measures) if r.get("telemetry")),
+        None,
+    )
+    log_rec = {
         "attempt": attempt,
         "t_start": round(t_start, 1),
         "elapsed_s": round(time.time() - t_start, 1),
         "outcome": outcome,
         "probe": probe,
-    })
+    }
+    if telemetry:
+        log_rec["telemetry"] = {
+            k: telemetry.get(k)
+            for k in ("trace_path", "spans", "counter_samples",
+                      "quality_rows", "hbm")
+            if k in telemetry
+        }
+    _log(log_rec)
     if measures and outcome == "measured":
         # Headline = the XLA-path record; a same-window Pallas LP record is
         # attached as the A/B datum rather than replacing the headline.

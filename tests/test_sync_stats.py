@@ -81,9 +81,13 @@ def _coarsen_all(graph, ctx, target_n=128):
 
 @pytest.mark.parametrize("lp_kernel", ["xla", "pallas"])
 def test_coarsening_level_single_readback_scale12(lp_kernel):
-    """Acceptance (ISSUE 2): blocking device->host transfers per coarsening
-    level <= 1 on the LP/XLA and LP/Pallas paths at scale 12, and zero
-    implicit scalar pulls inside the level loop."""
+    """Acceptance (ISSUE 2 + ISSUE 5): blocking device->host transfers per
+    coarsening level <= 1 on the LP/XLA and LP/Pallas paths at scale 12, and
+    zero implicit scalar pulls inside the level loop — WITH telemetry armed,
+    so the per-level quality probes are proven sync-budget neutral exactly
+    where the budget is asserted."""
+    from kaminpar_tpu import telemetry
+
     g = generators.rmat_graph(12, 8, seed=1)
     g.total_node_weight  # facade precomputes this before partitioning
     ctx = Context()
@@ -92,23 +96,31 @@ def test_coarsening_level_single_readback_scale12(lp_kernel):
     ctx.coarsening.lp.num_iterations = 3 if lp_kernel == "pallas" else 5
     set_layout_build_mode("device")
     sync_stats.reset()
-    with sync_stats.tripwire():
-        coarsener = _coarsen_all(g, ctx)
+    with telemetry.run() as rec:
+        with sync_stats.tripwire():
+            coarsener = _coarsen_all(g, ctx)
     assert coarsener.contractions >= 2  # a real multi-level hierarchy
     snap = sync_stats.snapshot()["phases"]
-    # one batched stats readback per contraction, nothing else
+    # one batched stats readback per contraction, nothing else — the armed
+    # quality probes added zero transfers
     assert snap["coarsening"]["count"] == coarsener.contractions, snap
     assert snap["coarsening"]["implicit"] == 0, snap
     # the LP sweep loop is fully device-resident (lax.while_loop)
     lp_phase = snap.get("lp_clustering", {"count": 0, "implicit": 0})
     assert lp_phase["count"] == 0, snap
     assert lp_phase["implicit"] == 0, snap
+    # ... and the probes did fire: one quality row per pushed level
+    levels = [r for r in rec.quality if r["kind"] == "coarsening_level"]
+    assert len(levels) == coarsener.contractions
 
 
 def test_coarsening_budget_asserted_in_deep_pipeline():
     """deep.py's in-pipeline budget assertion (armed) holds on a full
     partition, and the pipeline runs under the implicit-sync tripwire
-    without any stray scalar pull in the coarsening phases."""
+    without any stray scalar pull in the coarsening phases.  Telemetry runs
+    armed (ISSUE 5): the per-level quality probes — including the packed
+    extend-partition cut pull — must pass the same armed budgets."""
+    from kaminpar_tpu import telemetry
     from kaminpar_tpu.graph.metrics import is_feasible
     from kaminpar_tpu.kaminpar import KaMinPar
 
@@ -121,10 +133,11 @@ def test_coarsening_budget_asserted_in_deep_pipeline():
     set_layout_build_mode("device")
     sync_stats.enable_budget_checks(True)
     try:
-        with sync_stats.tripwire():
-            s = KaMinPar(ctx=ctx)
-            s.set_graph(g)
-            part = s.compute_partition(4, epsilon=0.03)
+        with telemetry.run():
+            with sync_stats.tripwire():
+                s = KaMinPar(ctx=ctx)
+                s.set_graph(g)
+                part = s.compute_partition(4, epsilon=0.03)
     finally:
         sync_stats.enable_budget_checks(False)
     assert is_feasible(g, part, 4, s.ctx.partition.max_block_weights)

@@ -1,0 +1,460 @@
+"""Unified telemetry (ISSUE 5): run-trace spans, per-level quality probes,
+Chrome-trace export, phase registry, serve metrics exposition.
+
+The contracts under test:
+
+- a run under ``telemetry.run`` exports valid Chrome trace-event JSON
+  (monotonic per-thread timestamps, matched B/E pairs) with spans for every
+  top-level phase plus per-level quality counter samples;
+- the quality probes are *sync-budget neutral*: arming telemetry changes
+  neither the blocking-transfer counts per phase nor the computed partition
+  (probes either reuse already-pulled host values or pack scalars into
+  existing pulls);
+- ``tools trace`` validates and round-trips a trace file;
+- ``engine.metrics_text()`` parses as Prometheus text exposition and carries
+  queue depth, occupancy, and latency percentiles;
+- the timer tree survives concurrent scopes from engine worker threads
+  (per-thread subtrees merged at report time);
+- the canonical phase registry and the source tree cannot drift apart.
+"""
+
+import json
+import re
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import kaminpar_tpu
+from kaminpar_tpu import telemetry
+from kaminpar_tpu.context import Context, PartitioningMode
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.telemetry import phases, prometheus
+from kaminpar_tpu.telemetry import trace as ttrace
+from kaminpar_tpu.utils import sync_stats
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    ttrace.stop()  # a leaked recorder from a failed test must not cascade
+    sync_stats.reset()
+    yield
+    ttrace.stop()
+    sync_stats.reset()
+
+
+def _deep_ctx(k=4, contraction_limit=100):
+    ctx = Context()
+    ctx.mode = PartitioningMode.DEEP
+    ctx.partition.k = k
+    ctx.coarsening.contraction_limit = contraction_limit
+    return ctx
+
+
+def _partition(graph, ctx, k):
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    solver = KaMinPar(ctx=ctx)
+    solver.set_graph(graph)
+    return np.asarray(solver.compute_partition(k, epsilon=0.03))
+
+
+# -- trace export ------------------------------------------------------------
+
+
+def test_trace_export_valid_with_phase_spans_and_quality(tmp_path):
+    """Acceptance: a traced run produces a file that loads as valid Chrome
+    trace JSON and contains spans for the top-level phases plus per-level
+    quality counter samples."""
+    g = generators.rmat_graph(9, 8, seed=1)
+    out = tmp_path / "trace.json"
+    with telemetry.run(trace_out=str(out)) as rec:
+        _partition(g, _deep_ctx(contraction_limit=50), 4)
+    assert rec.quality, "no quality rows recorded"
+    kinds = {row["kind"] for row in rec.quality}
+    assert "coarsening_level" in kinds
+    assert "level_quality" in kinds  # packed cut/imbalance probe fired
+
+    obj = json.loads(out.read_text())
+    summary = telemetry.validate_chrome_trace(obj)  # raises on malformation
+    assert summary["spans"] > 0 and summary["counters"] > 0
+    for phase in ("partitioning", "coarsening", "initial_partitioning",
+                  "lp_clustering"):
+        assert phase in summary["span_names"], summary["span_names"]
+    assert "quality/coarsening_level" in summary["counter_names"]
+    assert "quality/level_quality" in summary["counter_names"]
+    assert "host_sync" in summary["counter_names"]
+    assert summary["quality_rows"] == len(rec.quality)
+    # level_quality rows carry the packed cut + derived imbalance
+    lq = [r for r in rec.quality if r["kind"] == "level_quality"]
+    assert all(r["cut"] is not None and r["cut"] >= 0 for r in lq)
+    assert any(r["imbalance"] is not None for r in lq)
+
+
+def test_quality_probes_budget_neutral_and_bit_identical():
+    """Arming telemetry changes neither the per-phase blocking-transfer
+    counts nor the partition itself (the probes' zero-extra-transfers
+    contract, end to end on the deep pipeline)."""
+    counts = {}
+    parts = {}
+    rows = 0
+    for armed in (False, True):
+        sync_stats.reset()
+        g = generators.rmat_graph(10, 8, seed=3)
+        ctx = _deep_ctx(k=4, contraction_limit=100)
+        ctx.seed = 7
+        if armed:
+            with telemetry.run() as rec:
+                parts[armed] = _partition(g, ctx, 4)
+            rows = len(rec.quality)
+        else:
+            parts[armed] = _partition(g, ctx, 4)
+        snap = sync_stats.snapshot()["phases"]
+        counts[armed] = {
+            ph: snap.get(ph, {"count": 0})["count"]
+            for ph in ("coarsening", "initial_partitioning",
+                       "extend_partition", "lp_refinement", "clp_refinement")
+        }
+    assert counts[False] == counts[True], counts
+    assert np.array_equal(parts[False], parts[True])
+    assert rows > 0
+
+
+def test_clp_cut_probe_rides_existing_pull():
+    """The CLP refiner's per-round cut probe packs into the per-iteration
+    moved-count pull: same transfer count, identical result."""
+    from kaminpar_tpu.context import ColoredLPContext
+    from kaminpar_tpu.graph.partitioned import PartitionedGraph
+    from kaminpar_tpu.refinement.clp_refiner import CLPRefiner
+    from kaminpar_tpu.utils import reseed
+
+    g = generators.grid2d_graph(16, 16)
+    rng = np.random.default_rng(0)
+    part = (np.arange(256) // 64).astype(np.int32)
+    flip = rng.random(256) < 0.2
+    part[flip] = rng.integers(0, 4, flip.sum())
+    W = int(np.asarray(g.node_w).sum())
+    caps = np.full(4, int(np.ceil(W / 4) * 1.1) + 1, dtype=np.int64)
+
+    results = {}
+    pulls = {}
+    for armed in (False, True):
+        reseed(11)
+        sync_stats.reset()
+        pg = PartitionedGraph.create(g, 4, part.copy(), caps)
+        if armed:
+            with telemetry.run() as rec:
+                out = CLPRefiner(ColoredLPContext()).refine(pg)
+            clp_rows = [r for r in rec.quality if r["kind"] == "clp_refinement"]
+            assert clp_rows and all(r["cut"] is not None for r in clp_rows)
+        else:
+            out = CLPRefiner(ColoredLPContext()).refine(pg)
+        results[armed] = np.asarray(out.partition)
+        pulls[armed] = sync_stats.snapshot()["phases"]["clp_refinement"]["count"]
+    assert pulls[False] == pulls[True]
+    assert np.array_equal(results[False], results[True])
+
+
+# -- validation + tools round-trip ------------------------------------------
+
+
+def test_validate_rejects_malformed_traces():
+    rec = ttrace.TraceRecorder()
+    rec.begin("a")
+    rec.end("a")
+    ok = rec.chrome_trace()
+    telemetry.validate_chrome_trace(ok)
+
+    bad_unmatched = {"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 1.0, "pid": 1, "tid": 0}]}
+    with pytest.raises(ValueError, match="unmatched"):
+        telemetry.validate_chrome_trace(bad_unmatched)
+
+    bad_order = {"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 5.0, "pid": 1, "tid": 0},
+        {"name": "x", "ph": "E", "ts": 4.0, "pid": 1, "tid": 0}]}
+    with pytest.raises(ValueError, match="backwards"):
+        telemetry.validate_chrome_trace(bad_order)
+
+    bad_cross = {"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 1.0, "pid": 1, "tid": 0},
+        {"name": "y", "ph": "E", "ts": 2.0, "pid": 1, "tid": 0}]}
+    with pytest.raises(ValueError, match="does not match"):
+        telemetry.validate_chrome_trace(bad_cross)
+
+    bad_counter = {"traceEvents": [
+        {"name": "c", "ph": "C", "ts": 1.0, "pid": 1, "tid": 0,
+         "args": {"v": "not-a-number"}}]}
+    with pytest.raises(ValueError, match="numeric"):
+        telemetry.validate_chrome_trace(bad_counter)
+
+
+def test_open_spans_closed_at_export():
+    """A span still open at export gets a synthetic close so the written
+    file always validates (e.g. an engine thread mid-request at stop)."""
+    rec = ttrace.TraceRecorder()
+    rec.begin("outer")
+    rec.begin("inner")
+    summary = telemetry.validate_chrome_trace(rec.chrome_trace())
+    assert summary["spans"] == 2
+
+
+def test_tools_trace_roundtrip(tmp_path, capsys):
+    rec = ttrace.TraceRecorder()
+    rec.begin("partitioning")
+    rec.counter("host_sync", {"count": 1, "bytes": 64})
+    rec.quality_row("coarsening_level", level=0, n=100, m=400, n_c=40, m_c=120,
+                    shrink=0.6)
+    rec.end("partitioning")
+    src = tmp_path / "t.json"
+    dst = tmp_path / "t2.json"
+    rec.write(str(src))
+
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    rc = tools_main(["trace", str(src), "--out", str(dst), "--quality"])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert "quality rows: 1" in stdout
+    assert "coarsening_level" in stdout
+    a = json.loads(src.read_text())
+    b = json.loads(dst.read_text())
+    assert a["traceEvents"] == b["traceEvents"]
+    assert a["otherData"]["quality"] == b["otherData"]["quality"]
+    # a corrupt file is rejected, not re-emitted
+    src.write_text(json.dumps({"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 1.0, "pid": 1, "tid": 0}]}))
+    assert tools_main(["trace", str(src)]) == 1
+
+
+# -- serve metrics exposition ------------------------------------------------
+
+
+def test_engine_metrics_text_is_valid_prometheus():
+    from kaminpar_tpu.serve import PartitionEngine
+
+    engine = PartitionEngine("serve")
+    engine.start(warmup=False)
+    try:
+        fut = engine.submit(generators.rmat_graph(6, 4, seed=1), 2)
+        fut.result(timeout=180)
+        text = engine.metrics_text()
+    finally:
+        engine.shutdown(drain=True)
+    families = prometheus.validate(text)  # raises on malformed exposition
+    assert prometheus.get_sample(families, "kaminpar_serve_queue_depth") is not None
+    assert prometheus.get_sample(
+        families, "kaminpar_serve_requests_total", outcome="completed") >= 1
+    assert prometheus.get_sample(
+        families, "kaminpar_serve_batch_occupancy", stat="mean") >= 1
+    for quantile in ("0.5", "0.99"):
+        assert prometheus.get_sample(
+            families, "kaminpar_serve_latency_ms",
+            stage="total", quantile=quantile) is not None
+    assert prometheus.get_sample(families, "kaminpar_serve_warm_hit_rate") is not None
+
+
+def test_prometheus_render_and_validate_inverse():
+    text = prometheus.render([
+        ("x_total", "counter", "help with spaces", [({"a": "b\"c"}, 3)]),
+        ("y", "gauge", "h", [({}, 1.5), ({"q": "0.5"}, None)]),
+    ])
+    families = prometheus.validate(text)
+    assert families["x_total"] == [({"a": 'b\\"c'}, 3.0)]
+    assert families["y"] == [({}, 1.5)]  # None sample skipped
+    with pytest.raises(ValueError):
+        prometheus.validate("junk line without value\n# TYPE junk gauge\n")
+
+
+# -- timer thread-safety (satellite) ----------------------------------------
+
+
+def test_timer_merges_concurrent_thread_subtrees():
+    from kaminpar_tpu.utils.timer import Timer, scoped_timer
+
+    Timer.reset_global()
+    n_threads, iters = 6, 25
+
+    def worker():
+        for _ in range(iters):
+            with scoped_timer("partitioning"):
+                with scoped_timer("coarsening"):
+                    pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    worker()  # the main thread participates concurrently
+    for t in threads:
+        t.join()
+    timer = Timer.global_()
+    merged = timer.merged_root()
+    total = (n_threads + 1) * iters
+    assert merged.children["partitioning"].starts == total
+    assert merged.children["partitioning"].children["coarsening"].starts == total
+    assert timer.phase_seconds("partitioning", "coarsening") is not None
+    assert timer.machine_readable().startswith("TIME partitioning=")
+    Timer.reset_global()
+
+
+def test_threaded_engine_burst_keeps_timer_and_trace_consistent(tmp_path):
+    """Regression (satellite): concurrent submits + the engine's dispatcher
+    thread running scoped_timer scopes must corrupt neither the timer tree
+    nor the trace's per-thread B/E nesting."""
+    from kaminpar_tpu.serve import PartitionEngine
+    from kaminpar_tpu.utils.timer import Timer
+
+    out = tmp_path / "serve_trace.json"
+    engine = PartitionEngine("serve", max_batch=4)
+    with telemetry.run(trace_out=str(out)):
+        engine.start(warmup=False)
+        try:
+            futures = []
+            errors = []
+
+            def submit_some(seed):
+                try:
+                    for i in range(2):
+                        futures.append(engine.submit(
+                            generators.rmat_graph(6, 4, seed=seed + i), 2))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=submit_some, args=(10 * t,))
+                       for t in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            for fut in futures:
+                fut.result(timeout=300)
+        finally:
+            engine.shutdown(drain=True)
+    # Matched-B/E validation per (pid, tid) is exactly the property the old
+    # shared-stack timer raced on.
+    summary = telemetry.validate_chrome_trace(json.loads(out.read_text()))
+    assert summary["spans"] > 0
+    assert "serve.batch" in summary["span_names"]
+    assert "serve.queue" in summary["counter_names"]
+    # The merged timer report stays renderable after the burst.
+    assert isinstance(Timer.global_().render(), str)
+    assert isinstance(Timer.global_().machine_readable(), str)
+
+
+# -- logger JSON mode (satellite) -------------------------------------------
+
+
+def test_logger_json_mode(monkeypatch, capsys):
+    import sys
+
+    from kaminpar_tpu.utils.logger import Logger, OutputLevel, log_result_line
+
+    monkeypatch.setenv("KAMINPAR_TPU_LOG", "json")
+    # Logger.stream binds sys.stdout at import; point it at capsys' capture.
+    monkeypatch.setattr(Logger, "stream", sys.stdout)
+    old_level = Logger.level
+    Logger.level = OutputLevel.EXPERIMENT
+    try:
+        Logger.log("hello world")
+        line = log_result_line(42, 0.015, True, 8, 1.25)
+        Logger.warning("careful")
+    finally:
+        Logger.level = old_level
+    assert line.startswith("RESULT cut=42 ")  # return value stays parseable
+    captured = capsys.readouterr()
+    records = [json.loads(row) for row in captured.out.splitlines()]
+    assert records[0]["msg"] == "hello world"
+    assert records[0]["level"] == "application"
+    result = next(r for r in records if r.get("event") == "result")
+    assert result["cut"] == 42 and result["k"] == 8 and result["feasible"] is True
+    warn = json.loads(captured.err.splitlines()[-1])
+    assert warn["level"] == "warning" and warn["msg"] == "careful"
+
+
+def test_logger_plain_mode_unchanged(monkeypatch, capsys):
+    import sys
+
+    from kaminpar_tpu.utils.logger import Logger, OutputLevel, log_result_line
+
+    monkeypatch.delenv("KAMINPAR_TPU_LOG", raising=False)
+    monkeypatch.setattr(Logger, "stream", sys.stdout)
+    old_level = Logger.level
+    Logger.level = OutputLevel.EXPERIMENT
+    try:
+        log_result_line(7, 0.02, False, 2, 0.5)
+    finally:
+        Logger.level = old_level
+    out = capsys.readouterr().out
+    assert "RESULT cut=7 imbalance=0.02 feasible=0 k=2 time=0.5" in out
+
+
+# -- phase registry drift (satellite) ---------------------------------------
+
+
+_PHASE_LITERAL_PATTERNS = (
+    re.compile(r'scoped_timer\(\s*"([a-z_]+)"'),
+    re.compile(r'sync_stats\.scoped\(\s*"([a-z_]+)"'),
+    re.compile(r'assert_phase_budget\(\s*"([a-z_]+)"'),
+    re.compile(r'phase_count\(\s*"([a-z_]+)"'),
+    re.compile(r'phase="([a-z_]+)"'),
+)
+
+
+def _library_phase_literals():
+    pkg_root = Path(kaminpar_tpu.__file__).parent
+    sources = list(pkg_root.rglob("*.py"))
+    sources.append(pkg_root.parent / "bench.py")
+    found = {}
+    for path in sources:
+        text = path.read_text()
+        for pattern in _PHASE_LITERAL_PATTERNS:
+            for name in pattern.findall(text):
+                found.setdefault(name, set()).add(path.name)
+    return found
+
+
+def test_phase_registry_matches_source():
+    """A misspelled phase in the library silently escaped the sync budget
+    before the registry existed; now any drift — a source literal missing
+    from the registry OR a registry entry no source uses — fails tier-1."""
+    found = _library_phase_literals()
+    unknown = {n: sorted(f) for n, f in found.items()
+               if n not in phases.KNOWN_PHASES}
+    assert not unknown, (
+        f"phase names used in source but missing from the registry "
+        f"(kaminpar_tpu/telemetry/phases.py): {unknown}"
+    )
+    # "untracked" is sync_stats' fallback phase, assigned, never a literal
+    # at a scope site.
+    stale = {n for n in phases.KNOWN_PHASES - {"untracked"} if n not in found}
+    assert not stale, f"registry entries no source uses (remove or re-wire): {stale}"
+
+
+def test_unknown_phase_warns_once():
+    from kaminpar_tpu.utils.timer import scoped_timer
+
+    phases._warned.discard("zz_not_a_phase")
+    with pytest.warns(RuntimeWarning, match="phase registry"):
+        with scoped_timer("zz_not_a_phase"):
+            pass
+    assert phases.is_known("coarsening")
+    assert not phases.is_known("zz_not_a_phase")
+
+
+# -- HBM watermark (satellite) ----------------------------------------------
+
+
+def test_heap_watermark_report_shape():
+    from kaminpar_tpu.utils import heap_profiler
+
+    report = heap_profiler.watermark_report()
+    assert report["budget_doc"] == "HBM_BUDGET.md"
+    # Allocator stats are backend-dependent; when present they are ints and
+    # the peak fraction is derived consistently.
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in report:
+            assert isinstance(report[key], int)
+    if "peak_frac_of_limit" in report:
+        assert 0 <= report["peak_frac_of_limit"]
