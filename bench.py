@@ -365,6 +365,63 @@ def run_full_phase(record: dict | None = None) -> dict:
     return record
 
 
+def _run_lanestack_ab(scale: int, k: int, occupancy: int = 8,
+                      reps: int = 3) -> dict:
+    """Execute-phase wall of one full-occupancy same-cell batch: warm
+    per-graph loop vs ONE lane-stacked vmapped program, both measured over
+    ``reps`` warm passes (first pass unmeasured on each arm)."""
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.serve.batching import shape_cell
+    from kaminpar_tpu.serve.lanestack import run_lanestacked
+
+    # Distinct seeds from one RMAT family, filtered to the dominant shape
+    # cell — the batch the serve queue would actually form.
+    pool = [rmat_graph(scale, edge_factor=8, seed=200 + i) for i in range(24)]
+    cells = [shape_cell(g, k) for g in pool]
+    head = max(set(cells), key=cells.count)
+    graphs = [g for g, c in zip(pool, cells) if c == head][:occupancy]
+
+    solver = KaMinPar(ctx="serve")
+
+    def pergraph_once() -> None:
+        for g in graphs:
+            solver.set_graph(g)
+            solver.compute_partition(k, 0.03)
+
+    ctx = create_context_by_preset_name("serve")
+
+    def lanestack_once():
+        return run_lanestacked(ctx, graphs, k, 0.03)
+
+    pergraph_once()  # warm (traces + compiles)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pergraph_once()
+    pergraph_s = (time.perf_counter() - t0) / reps
+
+    _, report = lanestack_once()  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _, report = lanestack_once()
+    lanestack_s = (time.perf_counter() - t0) / reps
+
+    return {
+        "scale": scale,
+        "k": k,
+        "occupancy": len(graphs),
+        "reps": reps,
+        "pergraph_s": round(pergraph_s, 4),
+        "lanestack_s": round(lanestack_s, 4),
+        "lanestack_vs_pergraph": round(pergraph_s / lanestack_s, 2)
+        if lanestack_s else None,
+        "cohorts": report.cohorts,
+        "splits": report.splits,
+        "stacked_pulls": report.stacked_pulls,
+    }
+
+
 def run_serve_phase(record: dict | None = None) -> dict:
     """Phase 3 (ISSUE 3): serving throughput under the warm engine vs the
     status-quo single-request pattern, over an offered-load sweep.
@@ -465,9 +522,31 @@ def run_serve_phase(record: dict | None = None) -> dict:
                 "p50_ms": snap["latency_ms"]["total_ms"].get("p50"),
                 "p99_ms": snap["latency_ms"]["total_ms"].get("p99"),
                 "timed_out": snap["timed_out"],
+                # Lane-stack census (ISSUE 6): how many batches ran as one
+                # vmapped stack, at what realized lane occupancy, and how
+                # many fell back to the per-graph loop.
+                "lanestack_batches": snap["lanestacked_batches"],
+                "lanestack_occupancy_mean": snap["lanestack_occupancy_mean"],
+                "lanestack_fallbacks": snap["lanestack_fallbacks"],
             })
     finally:
         engine.shutdown(drain=True)
+
+    # Lane-stack execute-phase A/B (ISSUE 6): the same-cell batch the serve
+    # queue forms at full occupancy, executed (a) once per graph on the warm
+    # facade — the PR 3 pattern — and (b) as ONE lane-stacked vmapped
+    # program (serve/lanestack.py).  Both arms run once unmeasured (warm
+    # tax paid identically) and are then timed over `reps` passes; results
+    # are bit-identical by the lane-stack contract, so this isolates pure
+    # execute-phase wall.  Distinct seeds, honest workload: cohort splits
+    # (hierarchy divergence) are reported, not hidden.
+    try:
+        record["lanestack_ab"] = _run_lanestack_ab(
+            scale=max(scales), k=k,
+            occupancy=int(os.environ.get("KPTPU_BENCH_LANESTACK_OCC", 8)),
+        )
+    except Exception as exc:  # noqa: BLE001
+        record["lanestack_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
 
     # Baselines AFTER the engine phases so ordering cannot skew them:
     # warm_single shares the process's now-warm caches (the honest
@@ -494,6 +573,9 @@ def run_serve_phase(record: dict | None = None) -> dict:
         "serve_vs_warm_single": round(
             burst["throughput_gps"] / warm_single_gps, 2
         ) if warm_single_gps else None,
+        "lanestack_vs_pergraph": (record.get("lanestack_ab") or {}).get(
+            "lanestack_vs_pergraph"
+        ),
         "serve_sweep": sweep,
     })
     print(json.dumps(record), flush=True)
@@ -678,7 +760,8 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
         })
         if serve_rec and "serve_throughput_gps" in serve_rec:
             for key, val in serve_rec.items():
-                if key.startswith(("serve_", "single_request", "warm_single")):
+                if key.startswith(("serve_", "single_request", "warm_single",
+                                   "lanestack_")):
                     rec[key] = val
         else:
             rec["serve_error"] = serve_err or "serve phase produced no record"

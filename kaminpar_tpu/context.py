@@ -424,9 +424,10 @@ class ParallelContext:
     # geometric shape-bucket ladder (graph/csr.py), so caching the compiled
     # kernels on disk makes every run after the first start warm — on a
     # tunneled TPU that is ~35-48 s saved per kernel shape (TPU_NOTES.md).
-    # The facade applies these via configure_compilation_cache(); the
-    # env-var defaults (KAMINPAR_TPU_CACHE_DIR / KAMINPAR_TPU_NO_CACHE,
-    # applied at import in kaminpar_tpu/__init__.py) act as the fallback.
+    # The facade/engine owns these through its EngineRuntime (activated
+    # per run); the env-var defaults (KAMINPAR_TPU_CACHE_DIR /
+    # KAMINPAR_TPU_NO_CACHE, applied at import in kaminpar_tpu/__init__.py)
+    # act as the fallback.
     persistent_compilation_cache: bool = True
     compilation_cache_dir: str = ""  # "" = env var or ~/.cache default
     # Degree-bucketed layout construction backend (graph/csr.py):
@@ -443,76 +444,82 @@ class ParallelContext:
     sync_timers: bool = False
 
 
-# First-wins records of the process-global settings the configure_* entry
-# points have applied: group name -> the settings tuple that won.  A second
-# facade/engine instance re-applying *identical* settings is a no-op; a
-# *conflicting* one warns and leaves the first application untouched (it
-# must not clobber global JAX/layout config out from under a live engine).
-_configured: dict = {}
+# ---------------------------------------------------------------------------
+# Per-engine runtime ownership (ISSUE 6 unlocking refactor).
+#
+# Until round 11 the compilation-cache / layout-build / sync-timer settings
+# were applied as *first-wins process globals* (`_configure_once`): the first
+# facade or engine instance won, and a second instance with a conflicting
+# context got a RuntimeWarning and silently inherited the first one's
+# behavior.  That made heterogeneous warm pools (a small-graph lane engine
+# next to a big-graph engine in one process) impossible.  `EngineRuntime`
+# replaces the records with *ownership*: every facade/engine owns a runtime
+# derived from its own ParallelContext and activates it (thread-locally)
+# around its pipeline runs, so two engines with different configs coexist
+# and each one's dispatches see its own settings.
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+from contextlib import contextmanager as _contextmanager
+
+_tls_runtime = _threading.local()
+
+# Last cache settings actually pushed into the live jax config (the jax
+# compilation cache is genuinely process-global, so activation switches it
+# on demand and memoizes to avoid redundant config churn; entries from
+# several engines' cache dirs coexist on disk).
+_applied_cache_settings: list = [None]
+# The *process default* cache settings — what compiles outside any
+# activation should see.  Set by configure_compilation_cache (last wins)
+# or lazily captured from the live jax config (the import-time setup in
+# __init__.py uses raw jax.config updates) when the first activation
+# starts with no activation live anywhere in the process.  Every
+# stack-emptying activation exit restores this record, never a snapshot
+# of whatever another engine's thread applied mid-run.
+_process_default_cache: list = [None]
+_active_activations: list = [0]
+_cache_lock = _threading.Lock()
 
 
-def _configure_once(group: str, settings: tuple, apply) -> None:
-    prev = _configured.get(group)
-    if prev is None:
-        apply()
-        _configured[group] = settings
-        return
-    if prev != settings:
-        import warnings
-
-        warnings.warn(
-            f"kaminpar_tpu: conflicting {group} settings {settings!r} ignored — "
-            f"this process already applied {prev!r}.  Process-global "
-            "configuration is first-wins; run the differing instance in its "
-            "own process or call context.reset_global_configuration() first.",
-            RuntimeWarning,
-            stacklevel=3,
-        )
+def current_runtime() -> "Optional[EngineRuntime]":
+    """The :class:`EngineRuntime` active on this thread (innermost
+    activation), or None outside any activation."""
+    stack = getattr(_tls_runtime, "stack", None)
+    return stack[-1] if stack else None
 
 
-def reset_global_configuration() -> None:
-    """Forget the first-wins configure_* records so the next facade/engine
-    instance re-applies its settings (tests and long-lived REPLs)."""
-    _configured.clear()
+def _resolve_cache_settings(parallel: "ParallelContext") -> tuple:
+    import os
+
+    if not parallel.persistent_compilation_cache:
+        return (False, None)
+    cache_dir = (
+        parallel.compilation_cache_dir
+        or os.environ.get("KAMINPAR_TPU_CACHE_DIR")
+        or os.path.join(os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla")
+    )
+    return (True, cache_dir)
 
 
-def configure_compilation_cache(parallel: ParallelContext) -> None:
-    """Apply the context's persistent-cache settings to the live jax config.
+def _apply_cache_settings(settings: tuple) -> None:
+    """Push cache settings into the live jax config (memoized; last wins).
 
     Reference for why AOT executable caching stays off: the round-3 CPU
-    serializer crashes (see kaminpar_tpu/__init__.py).  Idempotent and
-    re-entrancy-safe: the first facade/engine instance wins; identical later
-    settings are a no-op and conflicting ones warn instead of clobbering.
-    """
+    serializer crashes (see kaminpar_tpu/__init__.py)."""
     import os
 
     if os.environ.get("KAMINPAR_TPU_NO_CACHE", "0") == "1":
         return  # env kill switch wins (benchmarks measuring cold compiles)
-    if not parallel.persistent_compilation_cache:
-        settings: tuple = (False, None)
+    with _cache_lock:
+        if _applied_cache_settings[0] == settings:
+            return
+        import jax
 
-        def apply() -> None:
-            import jax
-
-            try:
+        enabled, cache_dir = settings
+        try:
+            if not enabled:
                 jax.config.update("jax_compilation_cache_dir", None)
-            except Exception:  # pragma: no cover — optimization only
-                pass
-
-    else:
-        cache_dir = (
-            parallel.compilation_cache_dir
-            or os.environ.get("KAMINPAR_TPU_CACHE_DIR")
-            or os.path.join(
-                os.path.expanduser("~"), ".cache", "kaminpar_tpu", "xla"
-            )
-        )
-        settings = (True, cache_dir)
-
-        def apply() -> None:
-            import jax
-
-            try:
+            else:
                 os.makedirs(cache_dir, exist_ok=True)
                 # Tuning knobs are optional — their absence must not disable
                 # the cache itself.
@@ -530,32 +537,135 @@ def configure_compilation_cache(parallel: ParallelContext) -> None:
                 # keeps the cache off.
                 jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
-            except Exception:  # pragma: no cover — optimization only
-                pass
+            _applied_cache_settings[0] = settings
+        except Exception:  # pragma: no cover — optimization only
+            pass
 
-    _configure_once("compilation_cache", settings, apply)
+
+@dataclass(frozen=True)
+class EngineRuntime:
+    """Per-engine ownership of compilation-cache / layout / sync-timer
+    settings (the knobs that used to be first-wins process globals).
+
+    Built from a :class:`ParallelContext` by the facade or engine that owns
+    the pipeline, and *activated* (a thread-local stack, so nested runs and
+    concurrent engine dispatcher threads stay independent) around every
+    pipeline run:
+
+    - **compilation cache**: the jax cache-dir config is switched to this
+      runtime's settings on activation (the jax config is process-global,
+      so switching is on-demand and memoized; two engines' cache dirs
+      coexist on disk).  The switch happens at activation *entry* only —
+      compiles triggered while another engine's thread activates mid-run
+      land in the most recently applied dir.  That costs cache locality,
+      never correctness (entries are keyed by computation); layout and
+      sync-timer ownership below are thread-local and unaffected.
+    - **layout build**: ``graph.csr.resolve_layout_build_mode`` consults the
+      active runtime before the process default, so graphs built inside an
+      activation use this engine's builder even without a per-graph pin.
+    - **sync timers**: ``scoped_timer(..., sync=True)`` blocks per the
+      active runtime's flag, not a global switch.
+    """
+
+    cache_enabled: bool = True
+    cache_dir: Optional[str] = None
+    layout_build: str = "auto"
+    sync_timers: bool = False
+
+    @classmethod
+    def from_parallel(cls, parallel: "ParallelContext") -> "EngineRuntime":
+        enabled, cache_dir = _resolve_cache_settings(parallel)
+        return cls(
+            cache_enabled=enabled,
+            cache_dir=cache_dir,
+            layout_build=parallel.device_layout_build,
+            sync_timers=bool(parallel.sync_timers),
+        )
+
+    @_contextmanager
+    def activate(self):
+        """Context manager making this runtime current on this thread and
+        applying its compilation-cache settings to the live jax config.
+        When this thread's activation stack empties, the recorded *process
+        default* (:func:`configure_compilation_cache`, or the pre-activation
+        live config captured lazily) is restored — so a facade run never
+        clobbers the default for compiles outside any activation, even when
+        engine activations overlap across threads (the mid-run dir switches
+        such overlap causes cost cache locality, never correctness)."""
+        stack = getattr(_tls_runtime, "stack", None)
+        if stack is None:
+            stack = _tls_runtime.stack = []
+        with _cache_lock:
+            if _active_activations[0] == 0 and _process_default_cache[0] is None:
+                # First activation process-wide with no configured default:
+                # capture the live config (e.g. the import-time raw
+                # jax.config setup in __init__.py) as the default to
+                # restore.
+                try:
+                    import jax
+
+                    raw = jax.config.jax_compilation_cache_dir
+                    _process_default_cache[0] = (raw is not None, raw)
+                except Exception:  # pragma: no cover — optimization only
+                    pass
+            _active_activations[0] += 1
+        _apply_cache_settings((self.cache_enabled, self.cache_dir))
+        stack.append(self)
+        try:
+            yield self
+        finally:
+            stack.pop()
+            with _cache_lock:
+                _active_activations[0] -= 1
+                default = _process_default_cache[0]
+            prev = current_runtime()
+            if prev is not None:
+                _apply_cache_settings((prev.cache_enabled, prev.cache_dir))
+            elif default is not None:
+                _apply_cache_settings(default)
+
+
+def reset_global_configuration() -> None:
+    """Forget the memoized cache application and the recorded process
+    default so the next activation re-applies and re-captures
+    unconditionally (tests and long-lived REPLs).  Kept from the
+    first-wins era; there are no conflict records anymore."""
+    with _cache_lock:
+        _applied_cache_settings[0] = None
+        _process_default_cache[0] = None
+
+
+def configure_compilation_cache(parallel: ParallelContext) -> None:
+    """Apply the context's persistent-cache settings to the live jax config
+    as the process default (last-wins, no conflict warning) — the setting
+    activations restore when their stack empties.  Facades and engines own
+    an :class:`EngineRuntime` instead and activate it per run; this entry
+    point remains for tools and scripts that configure the process once up
+    front."""
+    settings = _resolve_cache_settings(parallel)
+    with _cache_lock:
+        _process_default_cache[0] = settings
+    _apply_cache_settings(settings)
 
 
 def configure_layout_build(parallel: ParallelContext) -> None:
-    """Apply the context's layout-build backend to graph construction
+    """Apply the context's layout-build backend as the process default
     (graph/csr.py global; the KAMINPAR_TPU_LAYOUT_BUILD env var overrides).
-    First-wins like :func:`configure_compilation_cache`; per-graph behavior
-    stays correct regardless because the facade pins its mode on each graph
-    (``CSRGraph._layout_mode``).  Direct ``set_layout_build_mode`` calls
-    (tests, tools) still take effect unconditionally."""
+    Last-wins; per-run behavior is governed by the owning facade/engine's
+    :class:`EngineRuntime` activation and the per-graph pin
+    (``CSRGraph._layout_mode``), which both take precedence."""
     from .graph.csr import set_layout_build_mode
 
-    mode = parallel.device_layout_build
-    _configure_once("layout_build", (mode,), lambda: set_layout_build_mode(mode))
+    set_layout_build_mode(parallel.device_layout_build)
 
 
 def configure_sync_timers(parallel: ParallelContext) -> None:
-    """Apply the context's sync-timers profiling switch (utils/timer.py).
-    First-wins; ``timer.set_sync_mode`` remains the unconditional override."""
+    """Apply the context's sync-timers profiling switch as the process
+    default (utils/timer.py).  Last-wins; the active
+    :class:`EngineRuntime`'s flag takes precedence inside activations."""
     from .utils import timer
 
-    on = bool(parallel.sync_timers)
-    _configure_once("sync_timers", (on,), lambda: timer.set_sync_mode(on))
+    timer.set_sync_mode(bool(parallel.sync_timers))
 
 
 @dataclass
@@ -590,6 +700,17 @@ class ServeContext:
     # Graceful-shutdown budget: how long shutdown(drain=True) waits for the
     # queue to empty before giving up on the dispatcher thread.
     drain_timeout_s: float = 60.0
+    # Lane-stacked batch execution (round 11, serve/lanestack.py): run a
+    # whole same-cell micro-batch through the multilevel pipeline as ONE
+    # vmapped program instead of once per graph.  "auto" lane-stacks
+    # eligible batches of >= 2 requests; "on" additionally stacks
+    # single-request batches (and makes fallbacks warn); "off" keeps the
+    # per-graph loop.  KAMINPAR_TPU_LANE_STACK overrides.
+    lane_stack: str = "auto"
+    # Lane counts to warm the lane-stacked pipeline at per (rung, k) cell
+    # during startup warmup (kind="lanestack" warmup-report rows); empty
+    # disables the pass (the per-graph warmup stays as is).
+    warm_lanes: tuple = ()
 
 
 @dataclass

@@ -44,8 +44,10 @@ def _next_bucket(x: int, minimum: int = 256) -> int:
 # round trip per hierarchy level on an accelerator), "device" (jitted
 # gathers fed by the 12-int degree histogram that rides the contraction
 # level's single batched readback — no bulk transfer), or "auto" (device
-# on accelerator backends).  Set via ParallelContext.device_layout_build
-# through context.configure_layout_build(), or KAMINPAR_TPU_LAYOUT_BUILD.
+# on accelerator backends).  Owned per facade/engine by the active
+# EngineRuntime (ParallelContext.device_layout_build); set_layout_build_mode
+# / context.configure_layout_build() set the process default, and
+# KAMINPAR_TPU_LAYOUT_BUILD overrides everything.
 _layout_build_mode = "auto"
 
 
@@ -62,12 +64,18 @@ def resolve_layout_build_mode(override: Optional[str] = None) -> str:
     """Env kill switch > per-graph override (CSRGraph._layout_mode, pinned
     by the facade and inherited through contraction — two KaMinPar
     instances with different settings must not reconfigure each other's
-    graphs) > process default."""
+    graphs) > the active EngineRuntime (context.current_runtime(), so two
+    engines with different layout configs coexist in one process) >
+    process default."""
     import os
 
+    from ..context import current_runtime
+
+    rt = current_runtime()
     mode = (
         os.environ.get("KAMINPAR_TPU_LAYOUT_BUILD", "")
         or override
+        or (rt.layout_build if rt is not None else "")
         or _layout_build_mode
     )
     if mode == "auto":

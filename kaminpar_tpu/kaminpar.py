@@ -41,17 +41,14 @@ class KaMinPar:
         # Optional warm serving engine (serve/engine.py): compute_partition
         # delegates to it instead of running the cold in-process pipeline.
         self._engine = engine
-        # Persistent compilation cache per the context's parallel settings
-        # (the env-var defaults applied at package import are the fallback).
-        from .context import (
-            configure_compilation_cache,
-            configure_layout_build,
-            configure_sync_timers,
-        )
+        # This facade OWNS its runtime settings (compilation cache, layout
+        # build, sync timers) instead of racing other instances for
+        # first-wins process globals: the runtime is activated thread-locally
+        # around every compute_partition, so two facades/engines with
+        # conflicting configs coexist in one process (ISSUE 6).
+        from .context import EngineRuntime
 
-        configure_compilation_cache(ctx.parallel)
-        configure_layout_build(ctx.parallel)
-        configure_sync_timers(ctx.parallel)
+        self.runtime = EngineRuntime.from_parallel(ctx.parallel)
         self.graph: Optional[CSRGraph] = None
         self.compressed_graph: Optional[object] = None
         self._last: Optional[PartitionedGraph] = None
@@ -156,9 +153,10 @@ class KaMinPar:
                 min_block_weights=min_block_weights,
             )
         try:
-            return self._compute_partition(
-                k, epsilon, max_block_weights, min_epsilon, min_block_weights
-            )
+            with self.runtime.activate():
+                return self._compute_partition(
+                    k, epsilon, max_block_weights, min_epsilon, min_block_weights
+                )
         finally:
             # An auto-detected weighted-mode pin is scoped to this call: a
             # caller may mutate the current graph's edge weights in place and
@@ -282,27 +280,26 @@ class KaMinPar:
             return part
 
         # Strip isolated nodes before partitioning and bin-pack them into
-        # the lightest blocks afterwards (reference: kaminpar.cc:388-429 —
-        # isolated nodes never affect the cut, but they dilute coarsening
-        # and refinement; RMAT-family graphs are full of them).
-        rp = np.asarray(graph.row_ptr)
-        deg = rp[1:] - rp[:-1]
-        isolated = np.flatnonzero(deg == 0)
+        # the lightest blocks afterwards (graph/isolated.py, shared with
+        # the lane-stacked serve runner whose bit-identity contract
+        # requires the exact same strip; RMAT-family graphs are full of
+        # isolated nodes).
+        from .graph.isolated import strip_isolated_csr
+
         work_graph = graph
-        keep = None
-        if 0 < len(isolated) < graph.n and k <= graph.n - len(isolated):
-            keep = np.flatnonzero(deg > 0)
+        keep = isolated = None
+        stripped = strip_isolated_csr(
+            np.asarray(graph.row_ptr),
+            lambda: np.asarray(graph.col_idx),
+            lambda: np.asarray(graph.node_w),
+            graph.n, k,
+        )
+        if stripped is not None:
+            keep, isolated, new_rp, new_col, new_nw = stripped
             from .graph.csr import from_numpy_csr
 
-            remap = np.full(graph.n, -1, dtype=np.int64)
-            remap[keep] = np.arange(len(keep))
-            new_rp = np.zeros(len(keep) + 1, dtype=np.int64)
-            np.cumsum(deg[keep], out=new_rp[1:])
             work_graph = from_numpy_csr(
-                new_rp,
-                remap[np.asarray(graph.col_idx)],
-                np.asarray(graph.node_w)[keep],
-                np.asarray(graph.edge_w),
+                new_rp, new_col, new_nw, np.asarray(graph.edge_w),
                 use_64bit=ctx.use_64bit_ids,
             )
             Logger.log(f"Removed {len(isolated)} isolated nodes")
@@ -311,37 +308,15 @@ class KaMinPar:
         p_graph = partitioner.partition()
 
         if keep is not None:
-            # Re-integrate: greedy lightest-block assignment respecting the
-            # caps (reference: graph::assign_isolated_nodes).  A k-entry
-            # heap keeps this O(n_iso log k) — RMAT graphs can have
-            # millions of isolated nodes.
-            import heapq
+            from .graph.isolated import assign_isolated_nodes
 
-            sub_part = np.asarray(p_graph.partition)
-            full_part = np.zeros(graph.n, dtype=sub_part.dtype)
-            full_part[keep] = sub_part
-            bw = np.bincount(
-                sub_part, weights=np.asarray(work_graph.node_w), minlength=k
-            ).astype(np.int64)
-            caps = np.asarray(ctx.partition.max_block_weights, dtype=np.int64)
-            iso_w = np.asarray(graph.node_w)[isolated]
-            order = np.argsort(-iso_w)  # heaviest first packs tightest
-            heap = [(int(bw[b]), b) for b in range(k)]
-            heapq.heapify(heap)
-            for u, w in zip(isolated[order], iso_w[order]):
-                w = int(w)
-                popped = []
-                while heap and heap[0][0] + w > caps[heap[0][1]]:
-                    popped.append(heapq.heappop(heap))
-                if heap:
-                    wt, b = heapq.heappop(heap)
-                else:  # nothing fits: overload the lightest block
-                    popped.sort()
-                    wt, b = popped.pop(0)
-                full_part[u] = b
-                heapq.heappush(heap, (wt + w, b))
-                for item in popped:
-                    heapq.heappush(heap, item)
+            full_part = assign_isolated_nodes(
+                graph.n, k, keep, isolated,
+                np.asarray(p_graph.partition),
+                np.asarray(work_graph.node_w),
+                np.asarray(graph.node_w),
+                np.asarray(ctx.partition.max_block_weights, dtype=np.int64),
+            )
             p_graph = PartitionedGraph.create(
                 graph, k, full_part,
                 ctx.partition.max_block_weights, ctx.partition.min_block_weights,

@@ -115,9 +115,9 @@ def _extend_partition_host(
     # block loop was the largek bottleneck (VERDICT r2 weak #5 / next-steps
     # #9).  Thread workers overlap the blocks' device dispatches and
     # GIL-releasing NumPy; each block's stream is deterministic.
-    import os as _os
+    from ..utils.platform import host_pool_workers
 
-    workers = min(max(len(jobs), 1), max(_os.cpu_count() or 1, 1), 16)
+    workers = host_pool_workers(len(jobs))
     results = []
     if jobs:
         from concurrent.futures import ThreadPoolExecutor
@@ -156,6 +156,11 @@ def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.
     sub_ctx.partition.min_block_weights = None
     sub_ctx.partition.total_node_weight = int(sub.node_w.sum())
     g = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
+    # Pin the owning context's layout-build mode: this runs in an extension
+    # thread-pool worker, where the engine's thread-local EngineRuntime
+    # activation is not visible — without the per-graph pin the worker
+    # would silently fall through to the process default.
+    g._layout_mode = sub_ctx.parallel.device_layout_build
     # Independent attempts, best (feasible-first, then cut) wins: extension
     # mistakes are unrecoverable downstream — the same reason the reference
     # repeats its initial bipartitioner (initial_pool_bipartitioner.cc).

@@ -18,6 +18,7 @@ from .batching import (
     unpack_partition,
 )
 from .engine import PartitionEngine, ServeFuture, ServeRequest, ServeResult
+from .lanestack import LaneStackReport, LaneStackUnsupported, run_lanestacked
 from .errors import (
     DeadlineExceededError,
     EngineStoppedError,
@@ -32,8 +33,11 @@ __all__ = [
     "BoundedServeQueue",
     "DeadlineExceededError",
     "EngineStoppedError",
+    "LaneStackReport",
+    "LaneStackUnsupported",
     "PackedBatch",
     "PartitionEngine",
+    "run_lanestacked",
     "QueueFullError",
     "RequestCancelledError",
     "ServeError",

@@ -145,6 +145,12 @@ class ServeRequest:
     caps: Optional[np.ndarray] = None
     execute_s: float = 0.0
     queue_wait_s: float = 0.0
+    # Unamortized service cost feeding the retry-after EMA.  Lane-stacked
+    # requests report execute_s = batch wall / occupancy (the latency
+    # share), but the drain-rate estimate divides the EMA by max_batch
+    # itself — feeding it the amortized share would double-count the batch
+    # width.  None = use execute_s (the per-graph loop, where they agree).
+    service_s: Optional[float] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now > self.deadline_t
@@ -186,10 +192,38 @@ class PartitionEngine:
         if serve_overrides:
             ctx.serve = replace(ctx.serve, **serve_overrides)
         self.serve: ServeContext = ctx.serve
+        # This engine OWNS its runtime settings (compilation cache, layout
+        # build, sync timers): the runtime is activated thread-locally
+        # around engine-side pipeline work (warmup, lane-stacked batches),
+        # so engines with conflicting configs coexist in one process
+        # (ISSUE 6; the internal facade activates its own equivalent
+        # runtime around per-graph runs).
+        from ..context import EngineRuntime
+
+        self.runtime = EngineRuntime.from_parallel(ctx.parallel)
+        lane_mode = str(getattr(self.serve, "lane_stack", "off")).strip().lower()
+        if lane_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ServeContext.lane_stack {self.serve.lane_stack!r}: "
+                "expected 'auto', 'on', or 'off'"
+            )
         self._queue = BoundedServeQueue(self.serve.queue_bound)
         self.stats_ = ServeStats()
         self._warm_nk: set = set()     # (n_bucket, k) — warm-hit accounting
         self._warm_cells: set = set()  # exact (n_bucket, m_bucket, k) cells
+        # Lane-stack shape keys THIS engine has already traced (warmup rows
+        # or a served batch): (LaneStackReport.layout_key, k, epsilon).
+        # Keying engine-locally keeps the warm-hit demotion in
+        # _try_lanestacked from misfiring on compile events raised by OTHER
+        # engines/facades in the process (the compile census is
+        # process-global).
+        self._warm_stack_keys: set = set()
+        # Lane-stack circuit breaker: consecutive *execution* failures
+        # (not eligibility fallbacks) latch the stacked path off for this
+        # engine so a deterministic mid-pipeline bug doesn't tax every
+        # batch with a doomed stacked attempt before its per-graph rerun.
+        self._lanestack_failures = 0
+        self._lanestack_broken = False
         self.warmup_report: List[dict] = []
         self._ids = itertools.count(1)
         self._solver = None
@@ -214,11 +248,17 @@ class PartitionEngine:
                 self._queue = BoundedServeQueue(self.serve.queue_bound)
             from ..kaminpar import KaMinPar
 
-            # The internal facade applies configure_* once; a second engine
-            # with conflicting global settings warns instead of clobbering
-            # (context._configure_once).
+            # The internal facade owns an EngineRuntime built from the same
+            # context, so its per-graph runs see this engine's settings
+            # regardless of other engines in the process (ISSUE 6).
             if self._solver is None:
                 self._solver = KaMinPar(copy.deepcopy(self.ctx))
+            # Always track compile events (idempotent): the lane-stack
+            # dispatch uses the census to keep warm-hit accounting honest
+            # even on warmup=False engines.
+            from ..utils import compile_stats
+
+            compile_stats.enable_compile_time_tracking()
             if warmup:
                 self._warmup()
             self._running = True
@@ -236,15 +276,25 @@ class PartitionEngine:
         from ..graph.generators import rmat_graph
         from ..utils import compile_stats
 
+        # ONE synthetic graph per rung, shared by every warm pass (the
+        # rung-to-scale mapping lives here alone, so the passes cannot
+        # drift).
+        rung_graphs: dict = {}
+
+        def rung_graph(n):
+            if n not in rung_graphs:
+                scale = max(2, int(np.ceil(np.log2(max(int(n), 4)))))
+                rung_graphs[n] = (scale, rmat_graph(
+                    scale, edge_factor=self.serve.warm_edge_factor, seed=1
+                ))
+            return rung_graphs[n]
+
         compile_stats.enable_compile_time_tracking()
         for n in self.serve.warm_ladder:
-            scale = max(2, int(np.ceil(np.log2(max(int(n), 4)))))
             for k in self.serve.warm_ks:
+                scale, g = rung_graph(n)
                 if k > (1 << scale):
                     continue
-                g = rmat_graph(
-                    scale, edge_factor=self.serve.warm_edge_factor, seed=1
-                )
                 cell = shape_cell(g, k)
                 before = compile_stats.compile_time_snapshot()
                 t0 = time.perf_counter()
@@ -264,24 +314,87 @@ class PartitionEngine:
                     "trace_s": round(after["trace_s"] - before["trace_s"], 3),
                 })
                 self._note_warm(cell)
-        self._warm_ip_pool()
+        self._warm_ip_pool(rung_graph)
+        self._warm_lanestack(rung_graph)
+        # Seed the retry-after service-time EMA from the warm execution
+        # cost (wall minus compile/trace — the steady-state share) so the
+        # very first admission rejects carry a real estimate instead of
+        # the blind floor (ISSUE 6 satellite).
+        execs = [
+            max(r["wall_s"] - r["backend_compile_s"] - r["trace_s"], 1e-3)
+            for r in self.warmup_report if "kind" not in r
+        ]
+        if execs:
+            self.stats_.seed_service_time(float(np.mean(execs)))
 
-    def _warm_ip_pool(self) -> None:
+    def _warm_lanestack(self, rung_graph) -> None:
+        """Precompile the lane-stacked pipeline per (rung, k, lane-count)
+        cell (``serve.warm_lanes``; kind="lanestack" report rows, printed
+        by ``tools warmup``).  Runs L copies of the rung's synthetic graph
+        (``rung_graph`` — _warmup's memoized per-rung generator) through
+        serve/lanestack.py — identical hierarchies, so the whole stack
+        stays one cohort and every vmapped kernel of the lockstep pipeline
+        gets traced at lane count L."""
+        if self._lane_stack_mode() == "off" or not self.serve.warm_lanes:
+            return
+        from ..utils import compile_stats
+        from .lanestack import LaneStackUnsupported, run_lanestacked
+
+        for n in self.serve.warm_ladder:
+            scale, g = rung_graph(n)
+            for k in self.serve.warm_ks:
+                if k < 2 or k > (1 << scale):
+                    continue  # per-cell envelope bound, not config-wide
+                for lanes in self.serve.warm_lanes:
+                    before = compile_stats.compile_time_snapshot()
+                    t0 = time.perf_counter()
+                    try:
+                        with self.runtime.activate():
+                            _, rep = run_lanestacked(
+                                self._solver.ctx, [g] * int(lanes), int(k), 0.03
+                            )
+                    except LaneStackUnsupported:
+                        return  # config outside the envelope: nothing to warm
+                    self._warm_stack_keys.add(
+                        (rep.layout_key, int(k), 0.03)
+                    )
+                    wall = time.perf_counter() - t0
+                    after = compile_stats.compile_time_snapshot()
+                    cell = shape_cell(g, int(k))
+                    self.warmup_report.append({
+                        "kind": "lanestack",
+                        "n": 1 << scale,
+                        "k": int(k),
+                        "n_bucket": cell.n_bucket,
+                        "m_bucket": cell.m_bucket,
+                        "lanes": int(lanes),
+                        "wall_s": round(wall, 3),
+                        "backend_compile_s": round(
+                            after["backend_compile_s"]
+                            - before["backend_compile_s"], 3
+                        ),
+                        "trace_s": round(
+                            after["trace_s"] - before["trace_s"], 3
+                        ),
+                    })
+
+    def _warm_ip_pool(self, rung_graph) -> None:
         """Precompile the lane-vmapped initial-bipartitioning pool per
         (n-bucket, m-bucket, lane-count) cell (ISSUE 4 satellite).  The
         synthetic warmup partitions above already trace the cells they
         visit; this pass AOT-compiles the k=2 bisection cell of every rung
         bucket explicitly — including the lane counts the adaptive
         repetition rule picks for each warm k — so the first real bisection
-        in a cell starts backend-compile-warm.  Device backend only: the
-        host pool has nothing to compile."""
+        in a cell starts backend-compile-warm (``rung_graph`` is _warmup's
+        memoized per-rung generator, so this pass warms the exact cell the
+        pipeline pass used).  Device backend only: the host pool has
+        nothing to compile."""
         from ..initial.bipartitioner import resolve_ip_backend
         from ..ops import bipartition as bip
 
         ipc = self.ctx.initial_partitioning
         if resolve_ip_backend(ipc) != "device":
             return
-        from ..graph.generators import rmat_graph
         from ..utils import compile_stats
 
         # Recursive bisection halves final_k per level (k, ceil(k/2), ...,
@@ -298,16 +411,18 @@ class PartitionEngine:
             # buckets of the same synthetic graph the warmup partitions
             # above use (an m-bucket estimated from the edge factor can
             # land one ladder rung off the real graph's).
-            scale = max(2, int(np.ceil(np.log2(max(int(n), 4)))))
-            pv = rmat_graph(
-                scale, edge_factor=self.serve.warm_edge_factor, seed=1
-            ).padded()
+            pv = rung_graph(n)[1].padded()
             n_pad, m_pad = pv.n_pad, pv.m_pad
             for methods in sorted(lane_layouts):
                 before = compile_stats.compile_time_snapshot()
-                wall = bip.warm_pool_executable(
-                    n_pad, m_pad, methods, ipc.fm_num_iterations
-                )
+                # Activate the engine's runtime so these compiles land in
+                # ITS persistent cache dir (like the pipeline warm pass and
+                # _warm_lanestack), not whatever dir is currently applied
+                # process-wide.
+                with self.runtime.activate():
+                    wall = bip.warm_pool_executable(
+                        n_pad, m_pad, methods, ipc.fm_num_iterations
+                    )
                 after = compile_stats.compile_time_snapshot()
                 self.warmup_report.append({
                     "kind": "ip_pool",
@@ -515,35 +630,189 @@ class PartitionEngine:
                 rec.end("serve.batch")
                 rec.counter("serve.queue", {"depth": len(self._queue)})
 
-    def _execute_live(self, live: List[ServeRequest]) -> None:
-        ok: List[ServeRequest] = []
+    def _lane_stack_mode(self) -> str:
+        """Effective lane-stack routing: env kill switch > serve context.
+        Values are normalized (case/whitespace); an unrecognized value at
+        dispatch time disables the stacked path (kill-switch-biased — a
+        typo'd override must never silently keep the feature on), while
+        an invalid *configured* value raises at engine construction."""
+        import os
+
+        mode = (
+            os.environ.get("KAMINPAR_TPU_LANE_STACK", "")
+            or getattr(self.serve, "lane_stack", "off")
+        ).strip().lower()
+        return mode if mode in ("auto", "on", "off") else "off"
+
+    def _lanestack_fallback(self, reason: str, warn: bool) -> None:
+        """Count one lane-stack fallback to the per-graph loop and, when
+        ``warn``, surface the reason as a RuntimeWarning."""
+        self.stats_.bump("lanestack_fallbacks")
+        if warn:
+            import warnings
+
+            warnings.warn(
+                f"kaminpar_tpu serve: {reason}; falling back to the "
+                "per-graph loop.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    def _try_lanestacked(
+        self, live: List[ServeRequest]
+    ) -> Optional[List[ServeRequest]]:
+        """Run the whole batch as ONE vmapped lane-stacked program
+        (serve/lanestack.py) when routing and eligibility allow; returns
+        the fulfilled requests, or None to fall back to the per-graph loop
+        (fallbacks are counted, and warned under ``lane_stack="on"``)."""
+        mode = self._lane_stack_mode()
+        if mode == "off" or (mode != "on" and len(live) < 2):
+            return None
+        if self._lanestack_broken:
+            # Breaker tripped (consecutive execution failures): skip the
+            # doomed stacked attempt; the counter keeps surfacing the lost
+            # parallelism, the trip itself already warned.
+            self.stats_.bump("lanestack_fallbacks")
+            return None
+        # Per-request constraint overrides are outside the lockstep
+        # envelope: the stacked pipeline computes every lane's caps from
+        # (k, epsilon), which the shape cell already holds fixed.
+        if any(
+            r.max_block_weights is not None
+            or r.min_block_weights is not None
+            or r.min_epsilon
+            for r in live
+        ) or len({r.epsilon for r in live}) != 1:
+            self._lanestack_fallback(
+                "lane_stack=on but the batch carries per-request "
+                "constraint overrides or mixed epsilons",
+                warn=mode == "on",
+            )
+            return None
+        from ..utils import compile_stats
+        from .lanestack import LaneStackUnsupported, run_lanestacked
+
+        pre_compiles = compile_stats.compile_time_snapshot()["compile_events"]
+        t0 = time.perf_counter()
+        try:
+            with self.runtime.activate():
+                parts, report = run_lanestacked(
+                    self._solver.ctx, [r.graph for r in live],
+                    live[0].k, live[0].epsilon,
+                )
+        except LaneStackUnsupported as exc:
+            self._lanestack_fallback(
+                f"lane_stack=on but the batch is outside the lane-stack "
+                f"envelope ({exc})",
+                warn=mode == "on",
+            )
+            return None
+        except Exception as exc:  # noqa: BLE001 — a lane-stack failure must
+            # not reject a batch the per-graph loop can still serve; fall
+            # back LOUDLY in every mode (the per-graph results remain
+            # correct, the warning and counter surface the lost
+            # parallelism).
+            self._lanestack_fallback(
+                f"lane-stacked execution failed ({type(exc).__name__}: {exc})",
+                warn=True,
+            )
+            self._lanestack_failures += 1
+            if self._lanestack_failures >= 3 and not self._lanestack_broken:
+                self._lanestack_broken = True
+                import warnings
+
+                warnings.warn(
+                    "kaminpar_tpu serve: lane-stacked execution failed on "
+                    f"{self._lanestack_failures} consecutive batches — "
+                    "disabling the stacked path for this engine (the "
+                    "per-graph loop keeps serving; restart the engine "
+                    "process to re-arm).",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            return None
+        wall = time.perf_counter() - t0
+        self._lanestack_failures = 0
+        # Key warm accounting on what this batch ACTUALLY dispatched: the
+        # runner's recorded layout key (level-0 stack buckets + per-level
+        # layout signatures x lane counts) with (k, epsilon) — the request
+        # cell alone can't name the executables, because the isolated-node
+        # strip moves work graphs across buckets and cohort splits change
+        # lane counts.
+        stack_key = (report.layout_key, live[0].k, live[0].epsilon)
+        compiled = (
+            stack_key not in self._warm_stack_keys
+            and compile_stats.compile_time_snapshot()["compile_events"]
+            > pre_compiles
+        )
+        # The submit-time warm flag covers the per-graph (bucket, k)
+        # executable; a stacked batch's warmth is the lane-stack cell's —
+        # correct the accounting in BOTH directions so warm_hit tracks
+        # whether this request's dispatch actually avoided a compile
+        # spike.  Gating the demotion on the engine-local key set keeps
+        # compiles raised by OTHER engines/facades in the process (the
+        # census is global) from demoting a batch whose stacked cell this
+        # engine already ran.
         for req in live:
-            # Queue wait runs until THIS request's execution starts, so a
-            # late batch member's wait includes in-batch serialization —
-            # reported percentiles must cover the full submit->resolve wall.
-            req.queue_wait_s = time.monotonic() - req.enqueue_t
-            t0 = time.perf_counter()
-            try:
-                # The warm facade runs the *identical* code path a cold
-                # sequential KaMinPar.compute_partition runs (including its
-                # per-call RNG reseed), so per-graph results are
-                # bit-identical to single-graph runs by construction.
-                self._solver.set_graph(req.graph)
-                req.partition = self._solver.compute_partition(
-                    req.k, req.epsilon, req.max_block_weights,
-                    req.min_epsilon, req.min_block_weights,
-                )
-                req.caps = np.asarray(
-                    self._solver.ctx.partition.max_block_weights,
-                    dtype=np.int64,
-                ).copy()
-                req.execute_s = time.perf_counter() - t0
-                ok.append(req)
-            except Exception as exc:  # noqa: BLE001 — per-request isolation
-                self.stats_.record_request(
-                    req.queue_wait_s, time.perf_counter() - t0, failed=True
-                )
-                req.future._reject(exc)
+            if compiled and req.warm_hit:
+                req.warm_hit = False
+                self.stats_.bump("warm_hits", -1)
+                self.stats_.bump("warm_misses")
+            elif not compiled and not req.warm_hit:
+                req.warm_hit = True
+                self.stats_.bump("warm_hits")
+                self.stats_.bump("warm_misses", -1)
+        self._warm_stack_keys.add(stack_key)
+        share = wall / len(live)
+        self.stats_.bump("lanestacked_batches")
+        self.stats_.bump("lanestacked_lanes", len(live))
+        self.stats_.bump("lanestack_splits", report.splits)
+        for i, req in enumerate(live):
+            # One stacked program serves all lanes; each request's execute
+            # share is the batch wall over occupancy, and the rest of the
+            # stacked wall counts as queue wait so queue_wait + execute
+            # still covers the full submit->resolve wall (the per-graph
+            # loop's percentile invariant).
+            req.queue_wait_s = time.monotonic() - req.enqueue_t - share
+            req.partition = parts[i]
+            req.caps = report.caps[i]
+            req.execute_s = share
+            req.service_s = wall
+        return list(live)
+
+    def _execute_live(self, live: List[ServeRequest]) -> None:
+        ok = self._try_lanestacked(live)
+        stacked = ok is not None
+        if ok is None:
+            ok = []
+            for req in live:
+                # Queue wait runs until THIS request's execution starts, so
+                # a late batch member's wait includes in-batch serialization
+                # — reported percentiles must cover the full submit->resolve
+                # wall.
+                req.queue_wait_s = time.monotonic() - req.enqueue_t
+                t0 = time.perf_counter()
+                try:
+                    # The warm facade runs the *identical* code path a cold
+                    # sequential KaMinPar.compute_partition runs (including
+                    # its per-call RNG reseed), so per-graph results are
+                    # bit-identical to single-graph runs by construction.
+                    self._solver.set_graph(req.graph)
+                    req.partition = self._solver.compute_partition(
+                        req.k, req.epsilon, req.max_block_weights,
+                        req.min_epsilon, req.min_block_weights,
+                    )
+                    req.caps = np.asarray(
+                        self._solver.ctx.partition.max_block_weights,
+                        dtype=np.int64,
+                    ).copy()
+                    req.execute_s = time.perf_counter() - t0
+                    ok.append(req)
+                except Exception as exc:  # noqa: BLE001 — per-request isolation
+                    self.stats_.record_request(
+                        req.queue_wait_s, time.perf_counter() - t0, failed=True
+                    )
+                    req.future._reject(exc)
         if not ok:
             return
 
@@ -562,9 +831,17 @@ class PartitionEngine:
         rec = ttrace.active()
         for i, req in enumerate(ok):
             req.execute_s += metrics_share_s
-            self._note_warm(req.cell)
+            if not stacked:
+                # A stacked batch traces only lane-stack executables — it
+                # does not warm the per-graph (bucket, k) cell, so marking
+                # it here would report a later lone request in this cell
+                # as a warm hit while it pays the full per-graph compile
+                # (the stacked path tracks its own _warm_stack_keys).
+                self._note_warm(req.cell)
             feasible = bool(np.all(bws[i] <= req.caps))
-            self.stats_.record_request(req.queue_wait_s, req.execute_s)
+            self.stats_.record_request(
+                req.queue_wait_s, req.execute_s, service_s=req.service_s
+            )
             req.future._resolve(ServeResult(
                 partition=req.partition,
                 cut=int(cuts[i]),

@@ -55,6 +55,12 @@ class ServeStats:
     _COUNTERS = (
         "submitted", "admitted", "rejected_full", "timed_out", "cancelled",
         "completed", "failed", "batches", "warm_hits", "warm_misses",
+        # Lane-stacked execution census (round 11, serve/lanestack.py):
+        # batches run as one vmapped stack, total lanes they carried,
+        # cohort splits inside them, and batches that fell back to the
+        # per-graph loop.
+        "lanestacked_batches", "lanestacked_lanes", "lanestack_splits",
+        "lanestack_fallbacks",
     )
 
     def __init__(self):
@@ -90,22 +96,41 @@ class ServeStats:
             self._occupancy_max = max(self._occupancy_max, int(occupancy))
 
     def record_request(
-        self, queue_wait_s: float, execute_s: float, failed: bool = False
+        self, queue_wait_s: float, execute_s: float, failed: bool = False,
+        service_s: Optional[float] = None,
     ) -> None:
+        """Latency percentiles take ``execute_s`` (a lane-stacked request's
+        amortized share); the retry-after EMA takes ``service_s`` — the
+        UNAMORTIZED cost of the dispatch that served the request (the batch
+        wall for lane-stacked work) — because :meth:`retry_after_estimate`
+        divides the EMA by the batch width itself.  None = execute_s."""
         with self._lock:
             self._c["failed" if failed else "completed"] += 1
             self._lat["queue_wait_ms"].add(queue_wait_s * 1e3)
             self._lat["execute_ms"].add(execute_s * 1e3)
             self._lat["total_ms"].add((queue_wait_s + execute_s) * 1e3)
             alpha = 0.2
+            svc = execute_s if service_s is None else service_s
             self.ema_service_s = (
-                execute_s if self.ema_service_s == 0.0
-                else (1 - alpha) * self.ema_service_s + alpha * execute_s
+                svc if self.ema_service_s == 0.0
+                else (1 - alpha) * self.ema_service_s + alpha * svc
             )
+
+    def seed_service_time(self, seconds: float) -> None:
+        """Initialize the service-time EMA from the warmup report's warm
+        execution cost (ISSUE 6 satellite): retry-after estimates are real
+        from the first admission reject instead of falling back to a blind
+        floor until the first completion.  A live EMA (completions already
+        recorded) is never overwritten."""
+        with self._lock:
+            if self.ema_service_s == 0.0 and seconds > 0.0:
+                self.ema_service_s = float(seconds)
 
     def retry_after_estimate(self, queue_depth: int, max_batch: int) -> float:
         """Backpressure hint: depth x smoothed service time / batch width,
-        floored so callers never busy-spin on a zero."""
+        floored so callers never busy-spin on a zero.  The EMA is seeded
+        from warmup (:meth:`seed_service_time`), so the pre-first-completion
+        fallback constant only applies to engines started without warmup."""
         with self._lock:
             per = self.ema_service_s or 0.1
         return max(0.05, queue_depth * per / max(1, max_batch))
@@ -133,6 +158,12 @@ class ServeStats:
                     / max(1, counts["warm_hits"] + counts["warm_misses"]),
                     4,
                 ),
+                # Mean lanes per stacked batch — the realized device
+                # parallelism of the lane-stacked path.
+                "lanestack_occupancy_mean": round(
+                    counts["lanestacked_lanes"]
+                    / counts["lanestacked_batches"], 3
+                ) if counts["lanestacked_batches"] else 0.0,
                 "latency_ms": {k: v.summary() for k, v in self._lat.items()},
                 "ema_service_s": round(self.ema_service_s, 4),
             }
@@ -192,6 +223,21 @@ class ServeStats:
              "Requests per dispatched micro-batch",
              [({"stat": "mean"}, snap["batch_occupancy_mean"]),
               ({"stat": "max"}, snap["batch_occupancy_max"])]),
+            ("kaminpar_serve_lanestack_batches_total", "counter",
+             "Micro-batches by lane-stack execution outcome",
+             [({"result": "stacked"}, snap["lanestacked_batches"]),
+              ({"result": "fallback"}, snap["lanestack_fallbacks"])]),
+            ("kaminpar_serve_lanestack_lanes_total", "counter",
+             "Total lanes executed by the lane-stacked pipeline",
+             [({}, snap["lanestacked_lanes"])]),
+            ("kaminpar_serve_lanestack_splits_total", "counter",
+             "Cohort splits inside lane-stacked batches (a high split rate "
+             "means lanes diverged and degenerated toward per-lane cohorts "
+             "— mandatory context for any lane-stack throughput figure)",
+             [({}, snap["lanestack_splits"])]),
+            ("kaminpar_serve_lanestack_occupancy", "gauge",
+             "Mean lanes per lane-stacked batch",
+             [({}, snap["lanestack_occupancy_mean"])]),
             ("kaminpar_serve_latency_ms", "gauge",
              "Latency percentiles in milliseconds over the rolling reservoir",
              lat_samples),

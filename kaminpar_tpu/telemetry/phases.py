@@ -46,6 +46,15 @@ AUX_PHASES = (
     "serve_batch_metrics",  # serve/batching.py packed-metrics readback
     "lp_bench_fence",       # bench.py microbench sync fences
     "untracked",            # sync_stats' default phase for unscoped pulls
+    # Lane-stacked serve execution (round 11, serve/lanestack.py): the
+    # stacked pipeline's scope plus the phase keys its lane-accounted
+    # stacked readbacks are counted under (one stacked pull serves the
+    # whole lane stack; sync_stats records lanes per pull).
+    "serve_lanestack",
+    "lanestack_coarsening",
+    "lanestack_ip",
+    "lanestack_refinement",
+    "lanestack_extend",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

@@ -45,14 +45,16 @@ def contraction_level(*, n, m, n_c, m_c, max_node_weight, total_edge_weight) -> 
 
 def coarsening_level(*, level, n, m, n_c, m_c, max_cluster_weight,
                      max_node_weight, total_edge_weight,
-                     lp_moved=None, lp_rounds_budget=None) -> None:
+                     lp_moved=None, lp_rounds_budget=None,
+                     lane=None) -> None:
     """The coarsener's per-level quality row: sizes, shrink, the LP moved
-    count — all host values from the level's one batched readback."""
+    count — all host values from the level's one batched readback.
+    ``lane`` tags rows emitted per lane of a lane-stacked serve batch (the
+    stacked stats pull carries the same values per lane)."""
     rec = _rec()
     if rec is None:
         return
-    rec.quality_row(
-        "coarsening_level",
+    row = dict(
         level=int(level), n=int(n), m=int(m), n_c=int(n_c), m_c=int(m_c),
         shrink=round(1.0 - n_c / max(n, 1), 4),
         max_cluster_weight=int(max_cluster_weight),
@@ -65,6 +67,9 @@ def coarsening_level(*, level, n, m, n_c, m_c, max_cluster_weight,
             int(lp_rounds_budget) if lp_rounds_budget is not None else None
         ),
     )
+    if lane is not None:
+        row["lane"] = int(lane)
+    rec.quality_row("coarsening_level", **row)
 
 
 def refinement_round(phase: str, *, round_idx, moved, cut=None) -> None:

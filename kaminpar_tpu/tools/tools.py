@@ -143,6 +143,10 @@ def warmup(argv) -> int:
     p.add_argument("--ks", default="8", help="comma-separated k values")
     p.add_argument("-P", "--preset", default="serve")
     p.add_argument("--edge-factor", type=int, default=8)
+    p.add_argument("--lanes", default="",
+                   help="comma-separated lane counts to warm the "
+                        "lane-stacked serve pipeline at (round 11; empty "
+                        "skips the lane-stack warm pass)")
     args = p.parse_args(argv)
     from ..serve.engine import PartitionEngine
     from ..utils import compile_stats
@@ -152,6 +156,7 @@ def warmup(argv) -> int:
         warm_ladder=tuple(int(s) for s in args.ladder.split(",") if s.strip()),
         warm_ks=tuple(int(s) for s in args.ks.split(",") if s.strip()),
         warm_edge_factor=args.edge_factor,
+        warm_lanes=tuple(int(s) for s in args.lanes.split(",") if s.strip()),
     )
     engine.start(warmup=True)
     try:
@@ -159,8 +164,11 @@ def warmup(argv) -> int:
         print(f"warmup ({args.preset} preset):")
         for row in engine.warmup_report:
             total_wall += row["wall_s"]
-            print(f"  cell n_bucket={row['n_bucket']} m_bucket={row['m_bucket']} "
-                  f"k={row['k']}: {row['wall_s']:.2f} s "
+            kind = row.get("kind", "pipeline")
+            lanes = f" lanes={row['lanes']}" if "lanes" in row else ""
+            print(f"  {kind} cell n_bucket={row['n_bucket']} "
+                  f"m_bucket={row['m_bucket']} k={row['k']}{lanes}: "
+                  f"{row['wall_s']:.2f} s "
                   f"(compile {row['backend_compile_s']:.2f} s, "
                   f"trace {row['trace_s']:.2f} s)")
         snap = compile_stats.snapshot()

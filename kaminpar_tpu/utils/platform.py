@@ -76,3 +76,12 @@ def force_cpu_devices(n_devices: int) -> list:
             "live before force_cpu_devices was called"
         )
     return devs[:n_devices]
+
+
+def host_pool_workers(jobs: int) -> int:
+    """Thread-pool sizing for independent host-side subproblems (per-block
+    extension in partitioning/deep.py, per-lane serve stages in
+    serve/lanestack.py — the reference's TBB-arena analogs): one worker
+    per job, capped by the machine and a 16-thread ceiling.  ONE policy so
+    the pools cannot drift apart."""
+    return min(max(int(jobs), 1), max(os.cpu_count() or 1, 1), 16)

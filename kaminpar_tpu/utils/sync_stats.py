@@ -44,7 +44,13 @@ import numpy as np
 from ..telemetry import trace as _ttrace
 
 _lock = threading.Lock()
-# phase -> [explicit_count, explicit_bytes, implicit_count, implicit_bytes]
+# phase -> [explicit_count, explicit_bytes, implicit_count, implicit_bytes,
+#           lane_pulls, stacked_count]
+# ``lane_pulls`` / ``stacked_count`` (round 11): a lane-stacked readback
+# moves L lanes' scalars in ONE blocking transfer; the stacked transfer
+# counts once in explicit_count (the budget currency) while lane_pulls
+# accumulates L (what the per-graph pipeline would have paid) — the census
+# quantifies the readbacks the lane stack amortized away.
 _counts: Dict[str, list] = {}
 _tls = threading.local()
 _budget_checks = False
@@ -80,14 +86,21 @@ def scoped(name: str):
         pop_phase()
 
 
-def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None) -> None:
+def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None,
+          lanes: int = 0) -> None:
     ph = phase or _phase()
     with _lock:
         row = _counts.get(ph)
         if row is None:
-            row = _counts[ph] = [0, 0, 0, 0]
+            row = _counts[ph] = [0, 0, 0, 0, 0, 0]
         row[kind_offset] += count
         row[kind_offset + 1] += nbytes
+        if lanes > 0:
+            # Every stacked pull counts, including L=1 (a single-request
+            # batch under lane_stack="on" still runs stacked): the census
+            # stays consistent with the engine's lanestacked_batches.
+            row[4] += lanes * count
+            row[5] += count
         total_count = sum(r[0] for r in _counts.values())
         total_bytes = sum(r[1] for r in _counts.values())
         total_implicit = sum(r[2] for r in _counts.values())
@@ -103,11 +116,17 @@ def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None) -
         })
 
 
-def pull(*arrays, phase: str | None = None):
+def pull(*arrays, phase: str | None = None, lanes: int = 0):
     """The sanctioned blocking device->host readback: materialize each array
     on the host, counting one blocking transfer (and its bytes) per array
     against the current phase.  Callers batch their per-level scalars into
     ONE array so one ``pull`` == one transfer.
+
+    ``lanes`` (round 11): mark a *lane-stacked* readback that carries L
+    lanes' data in one transfer — the transfer still counts once (budget
+    currency unchanged), while the per-lane census records the L logical
+    pulls the per-graph pipeline would have paid (``lane_pulls`` /
+    ``stacked_count`` in :func:`snapshot`).
 
     Returns a single ndarray for one input, else a tuple of ndarrays.
     """
@@ -119,7 +138,7 @@ def pull(*arrays, phase: str | None = None):
     with jax.transfer_guard_device_to_host("allow"):
         for a in arrays:
             host = np.asarray(a)
-            _bump(0, 1, int(host.nbytes), phase)
+            _bump(0, 1, int(host.nbytes), phase, lanes=lanes)
             out.append(host)
     return out[0] if len(out) == 1 else tuple(out)
 
@@ -138,8 +157,20 @@ def phase_count(name: str, implicit: bool = False) -> int:
         return row[2] if implicit else row[0]
 
 
+def lane_phase_count(name: str) -> Tuple[int, int]:
+    """(lane_pulls, stacked_count) of phase ``name`` — the per-lane
+    accounting pair of the lane-stacked serve pipeline (round 11)."""
+    with _lock:
+        row = _counts.get(name)
+        if row is None:
+            return (0, 0)
+        return (row[4], row[5])
+
+
 def snapshot() -> dict:
-    """{phase: {count, bytes, implicit, implicit_bytes}} plus totals."""
+    """{phase: {count, bytes, implicit, implicit_bytes, lane_pulls,
+    stacked_count}} plus totals.  ``lane_pulls - stacked_count`` per phase =
+    blocking transfers the lane stack amortized away."""
     with _lock:
         phases = {
             k: {
@@ -147,6 +178,8 @@ def snapshot() -> dict:
                 "bytes": v[1],
                 "implicit": v[2],
                 "implicit_bytes": v[3],
+                "lane_pulls": v[4],
+                "stacked_count": v[5],
             }
             for k, v in sorted(_counts.items())
         }
@@ -155,6 +188,8 @@ def snapshot() -> dict:
         "count": sum(p["count"] for p in phases.values()),
         "bytes": sum(p["bytes"] for p in phases.values()),
         "implicit": sum(p["implicit"] for p in phases.values()),
+        "lane_pulls": sum(p["lane_pulls"] for p in phases.values()),
+        "stacked_count": sum(p["stacked_count"] for p in phases.values()),
     }
 
 

@@ -226,7 +226,13 @@ def set_sync_mode(on: bool) -> None:
 
 
 def sync_mode() -> bool:
-    return _sync_mode
+    """Effective sync-timer flag: the active EngineRuntime's setting when a
+    pipeline activation is current on this thread (per-engine ownership,
+    ISSUE 6), else the process default set via :func:`set_sync_mode`."""
+    from ..context import current_runtime
+
+    rt = current_runtime()
+    return rt.sync_timers if rt is not None else _sync_mode
 
 
 @contextmanager
@@ -254,7 +260,7 @@ def scoped_timer(name: str, sync: bool = False):
                 try:
                     yield sentinel
                 finally:
-                    if sync and _sync_mode and sentinel.value is not None:
+                    if sync and sync_mode() and sentinel.value is not None:
                         import jax
 
                         jax.block_until_ready(sentinel.value)

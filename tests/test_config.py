@@ -106,33 +106,157 @@ def test_dist_preset_ladder():
     assert largek.initial_partitioning.device_extension
 
 
-def test_configure_globals_first_wins_and_warns():
-    """ISSUE 3 satellite: configure_* is idempotent and re-entrancy-safe —
-    a second facade/engine instance must not clobber the first's global
-    config; conflicting settings warn instead."""
+def test_engine_runtime_ownership_no_first_wins():
+    """ISSUE 6 unlocking refactor: the first-wins configure_* records are
+    gone — each facade/engine owns an :class:`EngineRuntime` and activates
+    it thread-locally, so two conflicting configs coexist in one process
+    with no RuntimeWarning and *independent* behavior inside each
+    activation."""
     import warnings
 
-    import pytest
-
     from kaminpar_tpu import context as ctx_mod
-    from kaminpar_tpu.context import ParallelContext, configure_sync_timers
+    from kaminpar_tpu.context import EngineRuntime, ParallelContext
+    from kaminpar_tpu.graph.csr import resolve_layout_build_mode
     from kaminpar_tpu.utils import timer
 
     prev_mode = timer.sync_mode()
+    try:
+        rt_a = EngineRuntime.from_parallel(
+            ParallelContext(sync_timers=False, device_layout_build="host")
+        )
+        rt_b = EngineRuntime.from_parallel(
+            ParallelContext(sync_timers=True, device_layout_build="device")
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # conflicting configs: no warning
+            with rt_a.activate():
+                assert timer.sync_mode() is False
+                assert resolve_layout_build_mode() == "host"
+                # Nested activation (engine dispatch inside a facade run):
+                # the inner runtime wins, the outer is restored after.
+                with rt_b.activate():
+                    assert timer.sync_mode() is True
+                    assert resolve_layout_build_mode() == "device"
+                assert timer.sync_mode() is False
+                assert resolve_layout_build_mode() == "host"
+        assert ctx_mod.current_runtime() is None
+    finally:
+        timer.set_sync_mode(prev_mode)
+
+
+def test_engine_runtime_cache_isolation(tmp_path):
+    """Two runtimes with different cache dirs: each activation applies its
+    own dir to the live jax config at entry (last-activation-wins on the
+    process-global jax config — concurrent engines may interleave, which
+    costs cache locality but never correctness)."""
+    import jax
+
+    from kaminpar_tpu import context as ctx_mod
+    from kaminpar_tpu.context import EngineRuntime, ParallelContext
+
+    prev = jax.config.jax_compilation_cache_dir
     ctx_mod.reset_global_configuration()
     try:
-        configure_sync_timers(ParallelContext(sync_timers=False))
-        # Identical settings: silent no-op (the common second-instance case).
-        with warnings.catch_warnings():
-            warnings.simplefilter("error")
-            configure_sync_timers(ParallelContext(sync_timers=False))
-        # Conflicting settings: warn, keep the first application.
-        with pytest.warns(RuntimeWarning, match="first-wins"):
-            configure_sync_timers(ParallelContext(sync_timers=True))
-        assert timer.sync_mode() is False
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        rt_a = EngineRuntime.from_parallel(
+            ParallelContext(compilation_cache_dir=dir_a)
+        )
+        rt_b = EngineRuntime.from_parallel(
+            ParallelContext(compilation_cache_dir=dir_b)
+        )
+        with rt_a.activate():
+            assert jax.config.jax_compilation_cache_dir == dir_a
+            with rt_b.activate():
+                assert jax.config.jax_compilation_cache_dir == dir_b
+            # Restored to the enclosing engine's setting on exit.
+            assert jax.config.jax_compilation_cache_dir == dir_a
     finally:
         ctx_mod.reset_global_configuration()
-        timer.set_sync_mode(prev_mode)
+        try:
+            jax.config.update("jax_compilation_cache_dir", prev)
+        except Exception:
+            pass
+
+
+def test_engine_runtime_restores_process_default_cache(tmp_path):
+    """The outermost activation restores whatever cache settings were
+    applied before it (the ``configure_compilation_cache`` process
+    default), so one facade run doesn't permanently clobber them for
+    compiles outside any activation (regression)."""
+    import jax
+
+    from kaminpar_tpu import context as ctx_mod
+    from kaminpar_tpu.context import (
+        EngineRuntime,
+        ParallelContext,
+        configure_compilation_cache,
+    )
+
+    prev = jax.config.jax_compilation_cache_dir
+    ctx_mod.reset_global_configuration()
+    try:
+        default_dir = str(tmp_path / "default")
+        configure_compilation_cache(
+            ParallelContext(compilation_cache_dir=default_dir)
+        )
+        assert jax.config.jax_compilation_cache_dir == default_dir
+        rt = EngineRuntime.from_parallel(
+            ParallelContext(persistent_compilation_cache=False)
+        )
+        with rt.activate():
+            assert jax.config.jax_compilation_cache_dir is None
+        assert jax.config.jax_compilation_cache_dir == default_dir
+
+        # Also when the default was applied with raw jax.config updates
+        # (the import-time setup in kaminpar_tpu/__init__.py) and nothing
+        # is recorded in the module's memo: activate() captures the live
+        # config as the default instead.
+        raw_dir = str(tmp_path / "raw")
+        jax.config.update("jax_compilation_cache_dir", raw_dir)
+        ctx_mod.reset_global_configuration()
+        with rt.activate():
+            assert jax.config.jax_compilation_cache_dir is None
+        assert jax.config.jax_compilation_cache_dir == raw_dir
+
+        # Overlapping activations on different threads (two engines' dispatch
+        # threads mid-run) still restore the true process default once the
+        # last one exits — never a snapshot of the other engine's settings.
+        import threading
+
+        default_dir2 = str(tmp_path / "default2")
+        configure_compilation_cache(
+            ParallelContext(compilation_cache_dir=default_dir2)
+        )
+        rt_a = EngineRuntime.from_parallel(
+            ParallelContext(compilation_cache_dir=str(tmp_path / "ov_a"))
+        )
+        rt_b = EngineRuntime.from_parallel(
+            ParallelContext(compilation_cache_dir=str(tmp_path / "ov_b"))
+        )
+        a_in, b_in, a_out = (threading.Event() for _ in range(3))
+
+        def thread_a():
+            with rt_a.activate():
+                a_in.set()
+                b_in.wait(10)
+            a_out.set()
+
+        def thread_b():
+            a_in.wait(10)
+            with rt_b.activate():  # enters while A is still active
+                b_in.set()
+                a_out.wait(10)  # exits after A
+
+        ta = threading.Thread(target=thread_a)
+        tb = threading.Thread(target=thread_b)
+        ta.start(); tb.start(); ta.join(15); tb.join(15)
+        assert jax.config.jax_compilation_cache_dir == default_dir2
+    finally:
+        ctx_mod.reset_global_configuration()
+        try:
+            jax.config.update("jax_compilation_cache_dir", prev)
+        except Exception:
+            pass
 
 
 def test_serve_context_roundtrips_and_preset():

@@ -257,7 +257,12 @@ def test_engine_warmup_reports_ip_pool_cells():
     ctx = Context()
     ctx.initial_partitioning.ip_backend = "device"
     engine = PartitionEngine(ctx, warm_ladder=(64,), warm_ks=(4,))
-    engine._warm_ip_pool()  # warmup's pool pass, without the full ladder
+    # warmup's pool pass, without the full ladder; the rung generator
+    # mirrors _warmup's (scale 6 for the 64-rung, same edge factor/seed).
+    from kaminpar_tpu.graph.generators import rmat_graph
+
+    engine._warm_ip_pool(lambda n: (6, rmat_graph(
+        6, edge_factor=engine.serve.warm_edge_factor, seed=1)))
     rows = [r for r in engine.warmup_report if r.get("kind") == "ip_pool"]
     assert rows, engine.warmup_report
     for row in rows:
@@ -269,5 +274,6 @@ def test_engine_warmup_reports_ip_pool_cells():
     ctx2 = Context()
     ctx2.initial_partitioning.ip_backend = "host"
     engine2 = PartitionEngine(ctx2, warm_ladder=(64,), warm_ks=(4,))
-    engine2._warm_ip_pool()
+    engine2._warm_ip_pool(lambda n: (6, rmat_graph(
+        6, edge_factor=engine2.serve.warm_edge_factor, seed=1)))
     assert not [r for r in engine2.warmup_report if r.get("kind") == "ip_pool"]

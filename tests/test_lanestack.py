@@ -1,0 +1,429 @@
+"""Lane-stacked serve execution tests (ISSUE 6).
+
+The hard contract: a lane-stacked batch result must be BIT-IDENTICAL to each
+graph's own sequential ``KaMinPar.compute_partition`` run — across families,
+shape buckets, k values, and lane counts (the tests/test_rng.py lane-count
+invariance property extended to the full multilevel pipeline).  Fast tests
+keep small graphs and reuse the scale-8 serve cells the rest of the tier
+compiles anyway; the full family x bucket x k x lane-count sweep is @slow.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.serve.engine import PartitionEngine
+from kaminpar_tpu.serve.lanestack import (
+    LaneStackUnsupported,
+    check_eligibility,
+    run_lanestacked,
+)
+
+
+def _rmat(seed, scale=8):
+    return generators.rmat_graph(scale, edge_factor=4, seed=seed)
+
+
+def _sequential(graphs, k, epsilon=0.03):
+    out = []
+    for g in graphs:
+        solver = KaMinPar(ctx="serve")
+        solver.set_graph(g)
+        out.append(solver.compute_partition(k, epsilon))
+    return out
+
+
+def _assert_identical(graphs, k, epsilon=0.03):
+    parts, report = run_lanestacked(
+        create_context_by_preset_name("serve"), graphs, k, epsilon
+    )
+    expected = _sequential(graphs, k, epsilon)
+    assert len(parts) == len(graphs)
+    for i, (got, want) in enumerate(zip(parts, expected)):
+        assert np.array_equal(got, want), (
+            f"lane {i} differs from its sequential run "
+            f"({int(np.sum(got != want))}/{got.size} labels)"
+        )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Runner-level bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_lanestack_identity_same_cell():
+    """Four same-cell RMAT lanes, one stacked run == four sequential runs."""
+    report = _assert_identical([_rmat(100 + s) for s in range(4)], k=4)
+    assert report.lanes == 4
+    assert report.stacked_pulls > 0
+
+
+def test_lanestack_lane_count_invariance():
+    """A lane's result is independent of how many other lanes ride the
+    stack (the rng.lane_keys property at pipeline scale): the same graph
+    through L=1, L=2, L=3 stacks equals its sequential run every time."""
+    g = _rmat(7)
+    solo = KaMinPar(ctx="serve")
+    solo.set_graph(g)
+    expected = solo.compute_partition(4, 0.03)
+    for L in (1, 2, 3):
+        graphs = [g] + [_rmat(100 + s) for s in range(L - 1)]
+        parts, _ = run_lanestacked(
+            create_context_by_preset_name("serve"), graphs, 4, 0.03
+        )
+        assert np.array_equal(parts[0], expected), f"lane 0 differs at L={L}"
+
+
+def test_lanestack_census_counts_single_lane():
+    """An L=1 stacked run (a single-request batch under lane_stack="on")
+    records its stacked pulls in the sync census too, staying consistent
+    with the engine's ``lanestacked_batches`` counter (regression: the
+    old ``lanes > 1`` guard dropped them)."""
+    from kaminpar_tpu.utils import sync_stats
+
+    before = sync_stats.snapshot()
+    _, report = run_lanestacked(
+        create_context_by_preset_name("serve"), [_rmat(42)], 4, 0.03
+    )
+    after = sync_stats.snapshot()
+    assert report.stacked_pulls > 0
+    stacked = after["stacked_count"] - before["stacked_count"]
+    assert stacked >= report.stacked_pulls
+    # At L=1 each stacked pull carries exactly one logical lane pull.
+    assert after["lane_pulls"] - before["lane_pulls"] == stacked
+
+
+def test_lanestack_identity_ragged_mixed_sizes():
+    """A ragged batch — lanes whose work graphs land in different shape
+    buckets (a star's hub strip + two rmat sizes) — splits into cohorts and
+    every lane still equals its sequential run."""
+    graphs = [
+        _rmat(3),
+        generators.star_graph(255),
+        _rmat(4, scale=7),
+        _rmat(5),
+    ]
+    report = _assert_identical(graphs, k=4)
+    assert report.cohorts >= 2  # mixed buckets cannot share one stack
+
+
+def test_lanestack_identity_with_coarsening():
+    """Scale 12 engages the multilevel hierarchy (contraction_limit 2000):
+    lockstep coarsening levels, per-lane early-exit/convergence splits, and
+    uncoarsen/refine all stay bit-identical; the per-level lane-accounted
+    sync budget is asserted in-pipeline (sync_stats.assert_phase_budget)."""
+    from kaminpar_tpu.utils import sync_stats
+
+    # n = 4096 > 2 * contraction_limit with no isolated-node shrink (an
+    # rmat at this scale strips below the threshold), so coarsening runs.
+    graphs = [
+        generators.grid2d_graph(64, 64),
+        generators.grid2d_graph(32, 128),
+    ]
+    sync_stats.enable_budget_checks(True)
+    try:
+        report = _assert_identical(graphs, k=4)
+    finally:
+        sync_stats.enable_budget_checks(False)
+    assert report.levels >= 1  # coarsening actually ran
+    lane_pulls, stacked = sync_stats.lane_phase_count("lanestack_coarsening")
+    assert stacked >= 1 and lane_pulls >= 2 * stacked
+
+
+def test_lanestack_ineligibility():
+    """Out-of-envelope configs raise :class:`LaneStackUnsupported` with the
+    reason, before any device work."""
+    from kaminpar_tpu.context import PartitioningMode
+
+    ctx = create_context_by_preset_name("serve")
+    ctx.mode = PartitioningMode.KWAY
+    with pytest.raises(LaneStackUnsupported, match="mode"):
+        check_eligibility(ctx, [_rmat(1)], 4)
+    ctx = create_context_by_preset_name("serve")
+    ctx.vcycles = 2
+    with pytest.raises(LaneStackUnsupported, match="v-cycle"):
+        check_eligibility(ctx, [_rmat(1)], 4)
+    with pytest.raises(LaneStackUnsupported, match="k exceeds"):
+        check_eligibility(
+            create_context_by_preset_name("serve"), [_rmat(1)], 10**6
+        )
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: routing, stats, fallback, runtime isolation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_lanestack_path_and_stats():
+    """A burst of same-cell requests rides the lane-stacked path (counted
+    in the stats census) and every result equals its sequential run."""
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          max_batch=4, queue_bound=16, lane_stack="on")
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        futs = [eng.submit(_rmat(100 + s), 4) for s in range(4)]
+        eng.resume()
+        results = [f.result(timeout=600) for f in futs]
+    finally:
+        eng.shutdown(drain=True)
+    expected = _sequential([_rmat(100 + s) for s in range(4)], 4)
+    for res, want, g in zip(
+        results, expected, [_rmat(100 + s) for s in range(4)]
+    ):
+        assert np.array_equal(res.partition, want)
+        from kaminpar_tpu.graph import metrics
+
+        assert res.cut == metrics.edge_cut(g, res.partition)
+        assert res.feasible
+    assert eng.stats_.counter("lanestacked_batches") >= 1
+    assert eng.stats_.counter("lanestacked_lanes") >= 2
+    snap = eng.stats()
+    assert snap["lanestack_occupancy_mean"] >= 2
+
+
+def test_engine_lanestack_fallback_loud_and_counted():
+    """``lane_stack="on"`` with an out-of-envelope pipeline falls back to
+    the per-graph loop with a RuntimeWarning and a counted fallback; the
+    result is still correct."""
+    ctx = create_context_by_preset_name("serve")
+    ctx.vcycles = 1  # outside the lockstep envelope
+    eng = PartitionEngine(ctx, warm_ladder=(), warm_ks=(),
+                          max_batch=4, queue_bound=16, lane_stack="on")
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        # Same seed -> same shape cell -> exactly one micro-batch.
+        futs = [eng.submit(_rmat(100), 4) for _ in range(2)]
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            eng.resume()
+            parts = [f.result(timeout=600).partition for f in futs]
+        assert any(
+            issubclass(w.category, RuntimeWarning)
+            and "lane-stack" in str(w.message)
+            for w in caught
+        )
+    finally:
+        eng.shutdown(drain=True)
+    assert eng.stats_.counter("lanestack_fallbacks") == 1
+    assert eng.stats_.counter("lanestacked_batches") == 0
+    g = _rmat(100)
+    for p in parts:
+        assert p.shape == (g.n,) and p.max() < 4
+
+
+def test_engine_lanestack_circuit_breaker(monkeypatch):
+    """Three consecutive lane-stack *execution* failures latch the stacked
+    path off for the engine: later batches skip the doomed attempt
+    entirely (run_lanestacked is no longer invoked) while the per-graph
+    loop keeps serving correct results, and the trip warns once."""
+    from kaminpar_tpu.serve import lanestack as ls_mod
+
+    calls = {"n": 0}
+
+    def _boom(*args, **kwargs):
+        calls["n"] += 1
+        raise RuntimeError("injected lane-stack failure")
+
+    monkeypatch.setattr(ls_mod, "run_lanestacked", _boom)
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          max_batch=4, queue_bound=16, lane_stack="on")
+    eng.start(warmup=False)
+    g = _rmat(100)
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # Single-request rounds: under lane_stack="on" even a 1-lane
+            # batch attempts the stacked path, and one-at-a-time sync
+            # submission makes the batch count deterministic (no
+            # batch-window races on round boundaries).
+            for _ in range(4):
+                p = eng.partition(_rmat(100), 4)
+                assert p.shape == (g.n,) and p.max() < 4
+        assert any(
+            issubclass(w.category, RuntimeWarning)
+            and "disabling the stacked path" in str(w.message)
+            for w in caught
+        )
+    finally:
+        eng.shutdown(drain=True)
+    assert calls["n"] == 3  # the 4th batch never attempted the stacked path
+    assert eng.stats_.counter("lanestack_fallbacks") == 4
+    assert eng.stats_.counter("lanestacked_batches") == 0
+
+
+def test_engine_per_request_overrides_fall_back():
+    """Explicit block-weight overrides are outside the stacked envelope —
+    the batch silently (counted) takes the per-graph loop and honors them."""
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          max_batch=4, queue_bound=16, lane_stack="auto")
+    eng.pause()
+    eng.start(warmup=False)
+    try:
+        g = _rmat(50)
+        caps = [int(g.total_node_weight)] * 4
+        futs = [
+            eng.submit(_rmat(50), 4, max_block_weights=caps)
+            for _ in range(2)
+        ]
+        eng.resume()
+        for f in futs:
+            f.result(timeout=600)
+    finally:
+        eng.shutdown(drain=True)
+    assert eng.stats_.counter("lanestacked_batches") == 0
+    assert eng.stats_.counter("lanestack_fallbacks") == 1
+
+
+def test_lane_stack_mode_validated_and_normalized(monkeypatch):
+    """An invalid configured ``lane_stack`` value raises at construction;
+    env overrides are case-normalized and unknown env values disable the
+    stacked path (a typo'd kill switch must never leave the feature on)."""
+    with pytest.raises(ValueError, match="lane_stack"):
+        PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                        lane_stack="true")
+    eng = PartitionEngine("serve", warm_ladder=(), warm_ks=(),
+                          lane_stack="on")
+    monkeypatch.setenv("KAMINPAR_TPU_LANE_STACK", "OFF")
+    assert eng._lane_stack_mode() == "off"
+    monkeypatch.setenv("KAMINPAR_TPU_LANE_STACK", "enabled")
+    assert eng._lane_stack_mode() == "off"
+    monkeypatch.delenv("KAMINPAR_TPU_LANE_STACK")
+    assert eng._lane_stack_mode() == "on"
+
+
+def test_two_engines_conflicting_configs_isolated():
+    """ISSUE 6 satellite: two engines with conflicting layout/sync-timer
+    configs coexist — no first-wins RuntimeWarning, independent behavior,
+    both bit-identical to their own sequential references."""
+    import copy
+
+    ctx_a = create_context_by_preset_name("serve")
+    ctx_a.parallel.device_layout_build = "host"
+    ctx_a.parallel.sync_timers = False
+    ctx_b = create_context_by_preset_name("serve")
+    ctx_b.parallel.device_layout_build = "device"
+    ctx_b.parallel.sync_timers = True
+
+    g = _rmat(42)
+    solo = KaMinPar(copy.deepcopy(ctx_a))
+    solo.set_graph(g)
+    expected = solo.compute_partition(4, 0.03)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        eng_a = PartitionEngine(ctx_a, warm_ladder=(), warm_ks=())
+        eng_b = PartitionEngine(ctx_b, warm_ladder=(), warm_ks=())
+        eng_a.start(warmup=False)
+        eng_b.start(warmup=False)
+        try:
+            part_a = eng_a.partition(_rmat(42), 4)
+            part_b = eng_b.partition(_rmat(42), 4)
+        finally:
+            eng_a.shutdown(drain=True)
+            eng_b.shutdown(drain=True)
+    assert eng_a.runtime.layout_build == "host"
+    assert eng_b.runtime.layout_build == "device"
+    assert eng_a.runtime.sync_timers is False
+    assert eng_b.runtime.sync_timers is True
+    # Identical results from both engines (the layout backends are
+    # bit-identical by the PR 2 contract) and from the sequential run.
+    assert np.array_equal(part_a, expected)
+    assert np.array_equal(part_b, expected)
+
+
+def test_retry_after_seeded_from_warmup():
+    """ISSUE 6 satellite: after warmup the service-time EMA is seeded from
+    the warmup report, so the first admission reject carries a real
+    retry-after estimate before any completion."""
+    eng = PartitionEngine(
+        "serve", warm_ladder=(64,), warm_ks=(4,), max_batch=1, queue_bound=1
+    )
+    eng.start(warmup=True)
+    try:
+        assert eng.stats_.counter("completed") == 0
+        assert eng.stats_.ema_service_s > 0.0
+        est = eng.stats_.retry_after_estimate(queue_depth=4, max_batch=1)
+        assert est >= 4 * eng.stats_.ema_service_s * 0.99
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_retry_after_ema_unamortized_for_stacked_shares():
+    """A lane-stacked request records execute_s = batch wall / occupancy
+    for latency percentiles, but the retry-after EMA must take the
+    UNAMORTIZED batch wall (``service_s``) — retry_after_estimate divides
+    by the batch width itself, so feeding it the amortized share would
+    double-count the occupancy and understate drain time by up to
+    max_batch x."""
+    from kaminpar_tpu.serve.stats import ServeStats
+
+    stats = ServeStats()
+    # 8-lane batch, 4 s wall: each request's latency share is 0.5 s but
+    # the dispatch that serves a queue slot costs 4 s.
+    for _ in range(8):
+        stats.record_request(0.1, 0.5, service_s=4.0)
+    assert stats.ema_service_s == pytest.approx(4.0)
+    # depth 16, max_batch 8 -> two more stacked dispatches ~ 8 s of drain.
+    est = stats.retry_after_estimate(queue_depth=16, max_batch=8)
+    assert est == pytest.approx(8.0)
+    # Per-graph path unchanged: service_s defaults to execute_s.
+    plain = ServeStats()
+    plain.record_request(0.1, 0.5)
+    assert plain.ema_service_s == pytest.approx(0.5)
+
+
+def test_warmup_report_lanestack_cells():
+    """``warm_lanes`` warms the lane-stacked pipeline and records
+    kind="lanestack" rows (printed by ``tools warmup``).  A k < 2 cell is
+    outside the lane-stack envelope per-cell only: it must be skipped, not
+    abort the warm pass for the remaining k (regression)."""
+    eng = PartitionEngine(
+        "serve", warm_ladder=(64,), warm_ks=(1, 4), warm_lanes=(2,),
+        max_batch=4, queue_bound=8,
+    )
+    eng.start(warmup=True)
+    try:
+        rows = [r for r in eng.warmup_report if r.get("kind") == "lanestack"]
+        assert len(rows) == 1 and rows[0]["k"] == 4
+        assert rows[0]["lanes"] == 2 and rows[0]["wall_s"] > 0
+    finally:
+        eng.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# The full sweep (heavy): families x buckets x k x lane counts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_lanestack_identity_sweep():
+    families = {
+        "rmat": lambda scale, seed: generators.rmat_graph(
+            scale, edge_factor=4, seed=seed
+        ),
+        "grid": lambda scale, seed: generators.grid2d_graph(
+            1 << (scale // 2), 1 << (scale - scale // 2)
+        ),
+        "star": lambda scale, seed: generators.star_graph((1 << scale) - 1),
+    }
+    for name, fn in families.items():
+        for scale in (8, 10):  # two node buckets
+            for k in (4, 8):
+                for L in (2, 4):
+                    graphs = [fn(scale, 300 + s) for s in range(L)]
+                    parts, _ = run_lanestacked(
+                        create_context_by_preset_name("serve"),
+                        graphs, k, 0.03,
+                    )
+                    expected = _sequential(graphs, k)
+                    for i, (got, want) in enumerate(zip(parts, expected)):
+                        assert np.array_equal(got, want), (
+                            name, scale, k, L, i
+                        )

@@ -131,8 +131,13 @@ def test_queue_same_cell_batch_extraction_preserves_order():
 
 
 def test_engine_lifecycle_and_stats():
+    # lane_stack="off": this test pins the PER-GRAPH path's warm-hit
+    # accounting; under lane-stacking a cold compile cache would demote
+    # the submit-time warm hits when the stacked program compiles (that
+    # path and its stats have their own tests in test_lanestack.py).
     eng = PartitionEngine(
-        "serve", warm_ladder=(256,), warm_ks=(4,), max_batch=4, queue_bound=8
+        "serve", warm_ladder=(256,), warm_ks=(4,), max_batch=4,
+        queue_bound=8, lane_stack="off",
     )
     eng.start(warmup=True)
     try:

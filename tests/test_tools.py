@@ -113,8 +113,11 @@ def test_compression_tool(metis_file):
 
 def test_warmup_tool():
     """`tools warmup` precompiles a (tiny) serving ladder and reports the
-    per-bucket compile seconds from compile_stats (ISSUE 3 satellite)."""
-    out = _run_tool("warmup", "--ladder", "64", "--ks", "4", "-P", "serve")
+    per-bucket compile seconds from compile_stats (ISSUE 3 satellite);
+    `--lanes` adds the lane-stacked pipeline cells (ISSUE 6 satellite)."""
+    out = _run_tool("warmup", "--ladder", "64", "--ks", "4", "-P", "serve",
+                    "--lanes", "2")
     assert out.returncode == 0, out.stderr
     assert "cell n_bucket=" in out.stdout
+    assert "lanestack cell" in out.stdout and "lanes=2" in out.stdout
     assert "distinct kernel specializations" in out.stdout
