@@ -350,6 +350,15 @@ def run_full_phase(record: dict | None = None) -> dict:
             }
         except Exception as exc:  # noqa: BLE001 — telemetry must not void the record
             record["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # kptlint summary (ISSUE 7): rule counts + baseline size ride the
+    # artifact so static-contract violation drift is visible in the perf
+    # trajectory alongside the runtime sync census above.
+    try:
+        from kaminpar_tpu.analysis.cli import lint_summary
+
+        record["lint"] = lint_summary()
+    except Exception as exc:  # noqa: BLE001 — lint must not void the record
+        record["lint_error"] = f"{type(exc).__name__}: {exc}"[:300]
     # Watermark captured — disarm the profiler so the serve phase's measured
     # request path does not pay per-scope allocator queries or accumulate
     # unbounded per-request heap-tree nodes.

@@ -1,0 +1,106 @@
+"""Fingerprinted baseline — explicit grandfathering of pre-existing findings.
+
+A baseline entry identifies a finding by ``(rule, path, normalized source
+line, occurrence index among identical lines in the file)`` — never by line
+number — so edits elsewhere in a file cannot silently invalidate (or worse,
+silently *satisfy*) an entry.  The file is JSON with a human-facing
+``notes`` field; ``tools lint --baseline-update`` rewrites ``entries`` from
+the current fresh findings and preserves the notes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence
+
+from .core import Finding, SourceModule
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = "kptlint_baseline.json"
+
+
+def _normalize(snippet: str) -> str:
+    return " ".join(snippet.split())
+
+
+def _digest(rule: str, path: str, snippet: str, index: int) -> str:
+    payload = f"{rule}\0{path}\0{_normalize(snippet)}\0{index}"
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+def compute_fingerprints(
+    findings: Sequence[Finding], modules: Dict[str, SourceModule]
+) -> None:
+    """Fill ``Finding.fingerprint`` in place.  The occurrence index counts
+    prior *findings of the same rule on identical source lines* in the same
+    file, so two textually identical violations get distinct fingerprints
+    and removing one genuinely un-baselines it."""
+    seen: Dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, _normalize(f.snippet))
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        f.fingerprint = _digest(f.rule, f.path, f.snippet, idx)
+
+
+class Baseline:
+    def __init__(self, entries: Iterable[dict] = (), notes: str = ""):
+        self.notes = notes
+        self.entries: List[dict] = list(entries)
+        self._index = {e["fingerprint"] for e in self.entries}
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def contains(self, finding: Finding) -> bool:
+        return finding.fingerprint in self._index
+
+    # -- persistence --------------------------------------------------------
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not Path(path).is_file():
+            return cls()
+        data = json.loads(Path(path).read_text())
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"unsupported kptlint baseline version {data.get('version')!r} "
+                f"in {path} (expected {BASELINE_VERSION})"
+            )
+        return cls(entries=data.get("entries", []), notes=data.get("notes", ""))
+
+    def save(self, path: Path) -> None:
+        data = {
+            "version": BASELINE_VERSION,
+            "notes": self.notes,
+            "entries": sorted(
+                self.entries,
+                key=lambda e: (e["path"], e.get("line", 0), e["rule"]),
+            ),
+        }
+        Path(path).write_text(json.dumps(data, indent=2) + "\n")
+
+    @classmethod
+    def from_findings(
+        cls, findings: Sequence[Finding], notes: str = ""
+    ) -> "Baseline":
+        entries = [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,  # informational only; matching is by print
+                "snippet": _normalize(f.snippet),
+                "fingerprint": f.fingerprint,
+            }
+            for f in findings
+            if not f.suppressed
+        ]
+        return cls(entries=entries, notes=notes)
+
+    def stale_entries(self, findings: Sequence[Finding]) -> List[dict]:
+        """Entries whose violation no longer exists (candidates for removal
+        at the next --baseline-update)."""
+        live = {f.fingerprint for f in findings}
+        return [e for e in self.entries if e["fingerprint"] not in live]

@@ -1,0 +1,390 @@
+"""kptlint rule framework: sources, suppressions, config, analyzer driver.
+
+Mirrors the role ``kaminpar-common/assert.h`` plays in the reference —
+compiled-in, always-on enforcement of the invariants the codebase leans on —
+but as whole-package static analysis, since our contracts (sync budget,
+runtime isolation, phase registry, RNG/donation safety) are about *where*
+code runs and *how* values cross the host/device boundary, which runtime
+assertions can only check on executed paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location.
+
+    ``fingerprint`` identifies the violation independent of its line number
+    (rule + path + normalized source line + occurrence index among identical
+    lines), so baseline entries survive unrelated edits above them.
+    """
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    baselined: bool = False
+    fingerprint: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def render(self) -> str:
+        return f"{self.location()}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "fingerprint": self.fingerprint,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Suppressions:  # kpt: ignore            (all rules, this line)
+#                # kpt: ignore[r1, r2]    (named rules, this line)
+#                # kpt: ignore-file[r1]   (named rules, whole file; must
+#                                          appear in the first 10 lines)
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*kpt:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*kpt:\s*ignore-file\[([A-Za-z0-9_,\- ]+)\]")
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Per-line rule suppressions (1-based line -> rule names or {"*"}) and
+    whole-file suppressions."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        if "kpt:" not in text:
+            continue
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            # only honored in the file header; further down it is neither a
+            # file-wide nor a line suppression (it must NOT degrade into a
+            # suppress-everything line marker via the plain-ignore regex)
+            if i <= 10:
+                file_wide.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            if m.group(1):
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            else:
+                rules = {"*"}
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------------------
+# Import-alias resolution
+# ---------------------------------------------------------------------------
+
+
+class ImportMap:
+    """Local name -> fully qualified module/attribute path for a module's
+    imports, with relative imports resolved against the module's dotted
+    name.  ``qualname(node)`` resolves a Name/Attribute chain through it:
+    ``np.asarray`` -> ``numpy.asarray``, a bare ``pull`` imported via
+    ``from ..utils.sync_stats import pull`` ->
+    ``kaminpar_tpu.utils.sync_stats.pull``."""
+
+    def __init__(self, tree: ast.AST, modname: str):
+        self.names: Dict[str, str] = {}
+        parts = modname.split(".")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.names[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+                    if alias.asname:
+                        self.names[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: strip `level` trailing components (the module
+                    # itself counts as one)
+                    base = parts[: len(parts) - node.level]
+                    prefix = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    prefix = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    full = f"{prefix}.{alias.name}" if prefix else alias.name
+                    self.names[alias.asname or alias.name] = full
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain with the root resolved
+        through the import map; None when the root is not a plain name."""
+        chain: List[str] = []
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.names.get(node.id, node.id)
+        chain.append(root)
+        return ".".join(reversed(chain))
+
+
+# ---------------------------------------------------------------------------
+# Source modules
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SourceModule:
+    """A parsed source file plus the derived per-module facts rules need."""
+
+    path: Path  # absolute
+    rel: str  # repo-relative posix path (finding identity)
+    modname: str  # dotted module name ("" for out-of-package extras)
+    text: str
+    lines: List[str]
+    tree: ast.Module
+    imports: ImportMap
+    suppress_lines: Dict[int, Set[str]]
+    suppress_file: Set[str]
+
+    @classmethod
+    def load(cls, path: Path, rel: str, modname: str) -> "SourceModule":
+        text = path.read_text()
+        # Relative imports in a package __init__ resolve against the package
+        # itself, so ImportMap needs the un-stripped module path.
+        import_modname = (
+            modname + ".__init__" if path.name == "__init__.py" else modname
+        )
+        return cls.from_source(
+            text, path=path, rel=rel, modname=modname,
+            import_modname=import_modname,
+        )
+
+    @classmethod
+    def from_source(
+        cls, text: str, *, path: Path = Path("<snippet>"),
+        rel: str = "<snippet>", modname: str = "kaminpar_tpu._snippet",
+        import_modname: Optional[str] = None,
+    ) -> "SourceModule":
+        tree = ast.parse(text)
+        lines = text.splitlines()
+        per_line, file_wide = _parse_suppressions(lines)
+        return cls(
+            path=path, rel=rel, modname=modname, text=text, lines=lines,
+            tree=tree, imports=ImportMap(tree, import_modname or modname),
+            suppress_lines=per_line, suppress_file=file_wide,
+        )
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.suppress_file:
+            return True
+        rules = self.suppress_lines.get(line)
+        return bool(rules and ("*" in rules or rule in rules))
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintConfig:
+    """Analyzer configuration: which files, which rules, per-rule options.
+
+    ``device_prefixes`` names the device-disciplined subpackages — the
+    pipeline, kernel, serving, and distributed tiers whose code runs inside
+    the sync budget and under ``EngineRuntime`` ownership.  IO-boundary
+    modules (io/, tools/, utils/, telemetry/, graph/, the facade) are exempt
+    from the device rules by not being listed; ``__main__.py`` drivers are
+    exempt wholesale (they are offline CLIs that print, which requires
+    pulling)."""
+
+    package_root: Path = None  # kaminpar_tpu/ directory
+    repo_root: Path = None  # its parent (baseline + rel paths anchor here)
+    device_prefixes: Tuple[str, ...] = (
+        "partitioning/", "coarsening/", "refinement/", "initial/",
+        "ops/", "serve/", "dist/",
+    )
+    exempt_basenames: Tuple[str, ...] = ("__main__.py",)
+    # Out-of-package sources included in package-wide rules (phase-registry
+    # literals live in bench.py too).
+    extra_files: Tuple[str, ...] = ("bench.py",)
+    enabled_rules: Optional[Tuple[str, ...]] = None  # None = all registered
+    rule_options: Dict[str, dict] = field(default_factory=dict)
+
+    def options(self, rule_name: str) -> dict:
+        return self.rule_options.get(rule_name, {})
+
+    def is_device_module(self, mod: SourceModule) -> bool:
+        rel = mod.rel
+        prefix = "kaminpar_tpu/"
+        if not rel.startswith(prefix):
+            # snippets: honour an explicit kaminpar_tpu-relative rel
+            return False
+        sub = rel[len(prefix):]
+        if Path(sub).name in self.exempt_basenames:
+            return False
+        return any(sub.startswith(p) for p in self.device_prefixes)
+
+
+def default_config() -> LintConfig:
+    pkg = Path(__file__).resolve().parent.parent
+    return LintConfig(package_root=pkg, repo_root=pkg.parent)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """Base class: per-module ``check`` plus an optional package-level
+    ``finalize`` (rules that need both directions of a registry, or
+    cross-module call resolution, run there)."""
+
+    name: str = "abstract"
+    description: str = ""
+
+    def check(self, mod: SourceModule, config: LintConfig) -> List[Finding]:
+        return []
+
+    def finalize(
+        self, modules: Sequence[SourceModule], config: LintConfig
+    ) -> List[Finding]:
+        return []
+
+    # helper for subclasses
+    def finding(
+        self, mod: SourceModule, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=self.name, path=mod.rel, line=line, col=col,
+            message=message, snippet=mod.line_text(line),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Analyzer
+# ---------------------------------------------------------------------------
+
+
+class Analyzer:
+    """Runs a rule set over the package (or explicit modules), applies
+    suppressions and the baseline, and reports findings.
+
+    ``run()`` returns ALL findings with ``suppressed`` / ``baselined``
+    flags set; ``fresh(findings)`` filters to the ones that should fail the
+    gate."""
+
+    def __init__(self, rules: Sequence[Rule], config: Optional[LintConfig] = None):
+        self.config = config or default_config()
+        if self.config.enabled_rules is not None:
+            rules = [r for r in rules if r.name in self.config.enabled_rules]
+        self.rules = list(rules)
+
+    # -- module discovery ---------------------------------------------------
+
+    def discover(self) -> List[SourceModule]:
+        cfg = self.config
+        mods: List[SourceModule] = []
+        pkg = cfg.package_root
+        for path in sorted(pkg.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(cfg.repo_root).as_posix()
+            modname = ".".join(
+                path.relative_to(cfg.repo_root).with_suffix("").parts
+            )
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            mods.append(SourceModule.load(path, rel, modname))
+        for extra in cfg.extra_files:
+            path = cfg.repo_root / extra
+            if path.is_file():
+                mods.append(
+                    SourceModule.load(path, Path(extra).as_posix(), "")
+                )
+        return mods
+
+    # -- running ------------------------------------------------------------
+
+    def run(
+        self,
+        modules: Optional[Sequence[SourceModule]] = None,
+        baseline: Optional["Baseline"] = None,
+    ) -> List[Finding]:
+        from .baseline import compute_fingerprints
+
+        if modules is None:
+            modules = self.discover()
+        findings: List[Finding] = []
+        for rule in self.rules:
+            for mod in modules:
+                for f in rule.check(mod, self.config):
+                    f.suppressed = mod.is_suppressed(rule.name, f.line)
+                    findings.append(f)
+            findings.extend(rule.finalize(modules, self.config))
+        compute_fingerprints(findings, {m.rel: m for m in modules})
+        if baseline is not None:
+            for f in findings:
+                if not f.suppressed and baseline.contains(f):
+                    f.baselined = True
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+    @staticmethod
+    def fresh(findings: Iterable[Finding]) -> List[Finding]:
+        return [f for f in findings if not f.suppressed and not f.baselined]
+
+    def check_source(
+        self, source: str, rel: str = "kaminpar_tpu/dist/_snippet.py",
+        modname: str = "kaminpar_tpu.dist._snippet",
+    ) -> List[Finding]:
+        """Analyze a source snippet as if it lived at ``rel`` — the fixture
+        and mutation-test entry point."""
+        mod = SourceModule.from_source(source, rel=rel, modname=modname)
+        return self.run(modules=[mod])
+
+
+def summarize(findings: Sequence[Finding]) -> dict:
+    """Machine-readable rollup (also embedded in bench.py artifacts)."""
+    per_rule: Dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed and not f.baselined:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {
+        "fresh": sum(1 for f in findings if not f.suppressed and not f.baselined),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "per_rule": dict(sorted(per_rule.items())),
+    }

@@ -1,0 +1,281 @@
+"""Per-function host/device value classification for the sync rule.
+
+A three-value lattice per expression:
+
+- ``HOST`` — definitely host-resident (numpy results, literals, config
+  attributes, ``sync_stats.pull`` results): materializing it again costs
+  nothing and is not a blocking transfer.
+- ``DEVICE`` — definitely device-derived (rooted at a ``jnp.``/``jax.``
+  call, a device-array attribute of a graph object, or a name assigned from
+  one): coercing it to a host scalar/array IS a blocking transfer.
+- ``UNKNOWN`` — could be either (function parameters, unresolved calls).
+
+The tracker is deliberately *local*: one linear pass per function body, no
+cross-function flow.  That keeps it predictable — a reviewer can always
+tell why a site was flagged — and the few host-only helpers that matter
+cross-module (``graph_to_host``, ``sync_stats.pull``) are declared in the
+rule options instead of inferred.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from .core import ImportMap
+
+HOST = "host"
+DEVICE = "device"
+UNKNOWN = "unknown"
+
+# numpy-array methods that preserve residency of their receiver (host numpy
+# stays host, device jax stays device).
+_PASSTHROUGH_METHODS = {
+    "astype", "reshape", "copy", "ravel", "flatten", "view", "transpose",
+    "sum", "max", "min", "mean", "prod", "cumsum", "any", "all", "argmax",
+    "argmin", "nonzero", "clip", "round", "squeeze", "tolist", "item",
+}
+
+# Array metadata that lives on the host regardless of where the buffer is
+# (reading .shape/.dtype never materializes a device array).
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "itemsize"}
+
+# Parameter annotations that pin a value's residency: host containers and
+# scalars on one side, device arrays on the other (leaf names — `np.ndarray`
+# matches "ndarray").  The codebase's own convention: HostCSR is the host
+# pool's CSR bundle, CSRGraph/PaddedView carry device arrays.
+# Deliberately no bare container names here: a host list can hold device
+# arrays, so containers classify by their element type (see
+# _TRANSPARENT_CONTAINERS below) or stay UNKNOWN when un-parameterized.
+_HOST_ANNOTATIONS = {
+    "ndarray", "HostCSR", "int", "float", "bool", "str", "bytes",
+    "Generator",
+}
+_DEVICE_ANNOTATIONS = {"Array", "jax.Array"}
+
+# Builtins whose results are host scalars/containers.
+_HOST_BUILTINS = {
+    "int", "float", "bool", "str", "len", "range", "sorted", "list",
+    "tuple", "dict", "set", "abs", "sum", "enumerate", "zip", "reversed",
+    "isinstance", "getattr", "hasattr", "id", "repr", "format", "round",
+}
+
+
+class Hostness:
+    """Expression classifier over one lexical scope's assignment history."""
+
+    def __init__(self, imports: ImportMap, options: dict):
+        self.imports = imports
+        self.env: Dict[str, str] = {}
+        # Names treated as host-resident roots wherever they appear (config
+        # trees and numpy RNGs by convention).
+        self.host_roots = set(options.get(
+            "host_roots",
+            ("ctx", "sub_ctx", "lane_ctx", "ipc", "cfg", "args", "rng",
+             "self_ctx"),
+        ))
+        # Dotted attribute prefixes treated as host (e.g. "self.ctx" — the
+        # config tree is plain host data even through an object).
+        self.host_attr_prefixes = tuple(options.get(
+            "host_attr_prefixes", ("self.ctx",),
+        ))
+        # Attribute names that are device arrays by codebase convention
+        # (CSRGraph / PaddedView / DistGraph / PartitionedGraph fields).
+        self.device_attrs = set(options.get(
+            "device_attrs",
+            ("row_ptr", "col_idx", "node_w", "edge_w", "edge_u", "col_loc",
+             "send_idx", "recv_map", "partition"),
+        ))
+        # Attribute names that are host values by codebase convention
+        # (partition caps are np arrays built by PartitionContext.setup).
+        self.host_attrs = set(options.get(
+            "host_attrs", ("max_block_weights", "min_block_weights"),
+        )) | _METADATA_ATTRS
+        # Fully qualified callables whose results are host values.
+        self.host_calls = set(options.get("host_calls", ())) | {
+            "kaminpar_tpu.utils.sync_stats.pull",
+            "kaminpar_tpu.utils.sync_stats.snapshot",
+            "kaminpar_tpu.partitioning.kway.graph_to_host",
+        }
+
+    def seed_from_signature(self, scope: ast.AST) -> None:
+        """Pin parameters whose annotations decide residency (``g:
+        HostCSR`` is host, ``x: jax.Array`` is device); unannotated
+        parameters stay UNKNOWN."""
+        args = getattr(scope, "args", None)
+        if args is None:
+            return
+        all_args = (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+        for a in all_args:
+            if a.annotation is None:
+                continue
+            leaf = _annotation_leaf(a.annotation)
+            if leaf in _HOST_ANNOTATIONS:
+                self.env[a.arg] = HOST
+            elif leaf in _DEVICE_ANNOTATIONS:
+                self.env[a.arg] = DEVICE
+
+    # -- statements ---------------------------------------------------------
+
+    def assign(self, target: ast.AST, value_class: str) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = value_class
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign(elt, value_class)
+
+    def observe(self, stmt: ast.stmt) -> None:
+        """Update the environment for one statement (assignments and for
+        targets; everything else leaves the env unchanged)."""
+        if isinstance(stmt, ast.Assign):
+            cls = self.classify(stmt.value)
+            for t in stmt.targets:
+                self.assign(t, cls)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, self.classify(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                cur = self.env.get(stmt.target.id, UNKNOWN)
+                self.env[stmt.target.id] = _join(cur, self.classify(stmt.value))
+        elif isinstance(stmt, ast.For):
+            self.assign(stmt.target, self.classify(stmt.iter))
+
+    # -- expressions --------------------------------------------------------
+
+    def qual(self, node: ast.AST) -> Optional[str]:
+        return self.imports.qualname(node)
+
+    def classify(self, node: ast.AST) -> str:  # noqa: C901 - one dispatch
+        if isinstance(node, (ast.Constant, ast.JoinedStr)):
+            return HOST
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict,
+                             ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return HOST
+        if isinstance(node, ast.Name):
+            if node.id in self.host_roots:
+                return HOST
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Attribute):
+            if node.attr in self.host_attrs:
+                return HOST
+            qual = self.qual(node)
+            if qual:
+                if qual.startswith(("numpy.", "math.")):
+                    return HOST
+                if qual.startswith(("jax.numpy.", "jax.")):
+                    return DEVICE
+                for prefix in self.host_attr_prefixes:
+                    if qual == prefix or qual.startswith(prefix + "."):
+                        return HOST
+            root = self.classify(node.value)
+            if root is HOST:
+                return HOST
+            if (
+                node.attr in self.device_attrs
+                and isinstance(node.value, ast.Name)
+                and node.value.id != "self"
+            ):
+                # `graph.node_w`-style field of a graph object: device by
+                # codebase convention.  `self.<field>` stays UNKNOWN — host
+                # data structures (the FM gain cache) reuse the same field
+                # names on self.
+                return DEVICE
+            return root
+        if isinstance(node, ast.Subscript):
+            return self.classify(node.value)
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        if isinstance(node, ast.BinOp):
+            return _join(self.classify(node.left), self.classify(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out = HOST
+            for v in node.values:
+                out = _join(out, self.classify(v))
+            return out
+        if isinstance(node, ast.Compare):
+            out = self.classify(node.left)
+            for c in node.comparators:
+                out = _join(out, self.classify(c))
+            return out
+        if isinstance(node, ast.IfExp):
+            return _join(self.classify(node.body), self.classify(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self.classify(node.value)
+        return UNKNOWN
+
+    def _classify_call(self, node: ast.Call) -> str:
+        qual = self.qual(node.func)
+        if qual:
+            if qual in self.host_calls:
+                return HOST
+            if qual.startswith("numpy."):
+                # includes numpy.asarray/array: AFTER materialization the
+                # value is host (the flagging of the materialization itself
+                # is the sync rule's job, not the classifier's)
+                return HOST
+            if qual.startswith("jax.numpy.") or qual.startswith("jax."):
+                return DEVICE
+            if qual in _HOST_BUILTINS:
+                return HOST
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _PASSTHROUGH_METHODS:
+                return self.classify(node.func.value)
+            recv = self.classify(node.func.value)
+            if recv is HOST:
+                # a method on a host object returns host data (rng.integers,
+                # parser.parse_args, host ndarray methods not listed above)
+                return HOST
+        return UNKNOWN
+
+
+# Generic containers are transparent for residency: a host list can hold
+# device arrays, so `Sequence[CSRGraph]` must classify by the ELEMENT type
+# (UNKNOWN here), while `Sequence[float]` is genuinely host.  The tracker
+# propagates a container's class to its elements (for-targets, subscripts),
+# so getting this wrong would hide device fields behind host containers.
+_TRANSPARENT_CONTAINERS = {
+    "Optional", "Sequence", "List", "list", "Tuple", "tuple", "Iterable",
+    "Iterator", "Set", "set", "FrozenSet", "frozenset",
+}
+
+
+def _annotation_leaf(ann: ast.expr) -> str:
+    """Residency-deciding type name of an annotation: ``np.ndarray`` ->
+    "ndarray", ``"HostCSR"`` -> "HostCSR", and container/Optional wrappers
+    resolve to their element type (``Sequence[np.ndarray]`` -> "ndarray",
+    ``Sequence[CSRGraph]`` -> "CSRGraph")."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        # string annotation: unwrap transparent containers textually
+        text = ann.value.strip()
+        while "[" in text:
+            head = text.split("[", 1)[0].strip().rsplit(".", 1)[-1]
+            if head not in _TRANSPARENT_CONTAINERS:
+                return head
+            text = text.split("[", 1)[1].rstrip("]").split(",", 1)[0].strip()
+        return text.rsplit(".", 1)[-1]
+    if isinstance(ann, ast.Subscript):
+        base = _annotation_leaf(ann.value)
+        if base in _TRANSPARENT_CONTAINERS:
+            slc = ann.slice
+            if isinstance(slc, ast.Tuple) and slc.elts:
+                slc = slc.elts[0]
+            return _annotation_leaf(slc)
+        return base
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Name):
+        return ann.id
+    return ""
+
+
+def _join(a: str, b: str) -> str:
+    if DEVICE in (a, b):
+        return DEVICE
+    if UNKNOWN in (a, b):
+        return UNKNOWN
+    return HOST

@@ -1,0 +1,87 @@
+"""Shared AST-walking helpers for the rule set.
+
+``iter_scopes`` yields each lexical scope's statement list exactly once
+(module body, then every def/async-def body, including nested ones) so
+rules that track per-scope state never double-visit a statement.
+``stmt_expressions`` returns a statement's *own* expressions — not those of
+its nested blocks, which the caller recurses into explicitly — and
+``walk_expr`` walks an expression tree without crossing into nested
+function/lambda bodies (their scopes are visited separately).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def iter_scopes(tree: ast.Module) -> Iterator[Tuple[ast.AST, List[ast.stmt]]]:
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPE_NODES):
+            yield node, node.body
+
+
+def stmt_expressions(stmt: ast.stmt) -> List[ast.expr]:
+    """The expressions evaluated by this statement itself (conditions,
+    values, targets, iterables, with-items, call decorators) — nested
+    statement blocks excluded."""
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, ast.With):
+        out: List[ast.expr] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+        return out
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg else [])
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, _SCOPE_NODES):
+        # decorators and defaults run in the enclosing scope
+        return list(stmt.decorator_list) + [
+            d for d in stmt.args.defaults + stmt.args.kw_defaults
+            if d is not None
+        ]
+    if isinstance(stmt, ast.ClassDef):
+        return list(stmt.decorator_list) + list(stmt.bases)
+    return []
+
+
+def walk_scope(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk every node lexically inside ``scope`` WITHOUT descending into
+    nested function/async-function definitions (each nested def is its own
+    scope and is visited by its own ``iter_scopes`` entry)."""
+    stack = [scope]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            stack.append(child)
+
+
+def walk_expr(expr: ast.expr) -> Iterator[ast.AST]:
+    """ast.walk over an expression tree, lambda bodies included — a lambda
+    is not a separate ``iter_scopes`` scope, so skipping its body would
+    leave any materialization written inside one permanently invisible to
+    the scope-based rules (its closure reads the enclosing environment,
+    which is exactly the tracker state the caller holds)."""
+    return ast.walk(expr)

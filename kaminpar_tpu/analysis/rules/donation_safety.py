@@ -1,0 +1,223 @@
+"""donation-safety: donated buffers must not be referenced after the call.
+
+``donate_argnums`` hands a buffer's storage to XLA: after the jitted call
+the donated array is invalid, and touching it raises (on accelerator
+backends) or silently reads stale memory through a zero-copy alias (the CPU
+backend — which is exactly why tier-1 CPU runs cannot catch this class).
+The ops kernels donate their state carries (``ops/lp.py``,
+``ops/contraction.py``, ``graph/bucketed.py``); callers follow the
+``state = step(state, ...)`` rebinding idiom.  This rule enforces the idiom
+statically:
+
+- collect every function whose decorator chain carries ``donate_argnums``
+  (``@partial(jax.jit, donate_argnums=(i,))``) plus every
+  ``name = jax.jit(fn, donate_argnums=...)`` binding, package-wide;
+- at each call site, a donated positional argument passed as a plain name
+  becomes *dead*: loading it later in the same scope is a finding, until a
+  rebind revives it.  ``x = f(x)`` is safe — the donation and the rebind
+  are the same statement.
+
+The scan is linear in source order through nested blocks (one shared dead
+set), which matches how the call sites are written.  Known limitation: a
+loop body that donates a name it read earlier in the same iteration is only
+caught on the textual order, not the back edge — the rebinding idiom makes
+that shape rare.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Tuple
+
+from ..core import Finding, LintConfig, Rule, SourceModule
+from ._walk import iter_scopes
+
+
+def _donated_argnums(call: ast.Call) -> Tuple[int, ...]:
+    """donate_argnums of a jax.jit(...) / partial(jax.jit, ...) call."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    elt.value for elt in v.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                )
+    return ()
+
+
+def _is_jit_call(call: ast.Call, mod: SourceModule) -> bool:
+    qual = mod.imports.qualname(call.func) or ""
+    if qual.rsplit(".", 1)[-1] == "jit":
+        return True
+    # partial(jax.jit, donate_argnums=...)
+    if qual.rsplit(".", 1)[-1] == "partial" and call.args:
+        inner = mod.imports.qualname(call.args[0]) or ""
+        return inner.rsplit(".", 1)[-1] == "jit"
+    return False
+
+
+def collect_donating(
+    modules: Sequence[SourceModule],
+) -> Dict[str, Tuple[int, ...]]:
+    """Leaf-name -> donated argnums for every donating jitted callable in
+    the module set.  Leaf names are unique enough in this package (the
+    kernels live in ops/) and keep call-site resolution simple and
+    reviewable."""
+    donating: Dict[str, Tuple[int, ...]] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_jit_call(dec, mod):
+                        nums = _donated_argnums(dec)
+                        if nums:
+                            donating[node.name] = nums
+            elif isinstance(node, ast.Assign):
+                if (
+                    isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value, mod)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    nums = _donated_argnums(node.value)
+                    if nums:
+                        donating[node.targets[0].id] = nums
+    return donating
+
+
+class DonationSafetyRule(Rule):
+    name = "donation-safety"
+    description = (
+        "arguments donated via donate_argnums must not be referenced after "
+        "the jitted call (rebind the carry: state = step(state, ...))"
+    )
+
+    def finalize(
+        self, modules: Sequence[SourceModule], config: LintConfig
+    ) -> List[Finding]:
+        donating = collect_donating(modules)
+        if not donating:
+            return []
+        mods_by_rel = {m.rel: m for m in modules}
+        out: List[Finding] = []
+        for mod in modules:
+            for _scope, body in iter_scopes(mod.tree):
+                self._scan(body, {}, donating, mod, out)
+        for f in out:
+            f.suppressed = mods_by_rel[f.path].is_suppressed(self.name, f.line)
+        return out
+
+    # -- linear scan with one shared dead set -------------------------------
+
+    def _scan(
+        self,
+        stmts: Sequence[ast.stmt],
+        dead: Dict[str, int],
+        donating: Dict[str, Tuple[int, ...]],
+        mod: SourceModule,
+        out: List[Finding],
+    ) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope: visited by its own iter_scopes entry
+            compound = isinstance(
+                stmt, (ast.If, ast.For, ast.While, ast.With, ast.Try)
+            )
+            if not compound:
+                self._flag_dead_uses(stmt, dead, mod, out)
+                for name in _stored_names(stmt):
+                    dead.pop(name, None)
+                self._register_donations(stmt, dead, donating, mod)
+            else:
+                # the statement's own expressions (test / iter / items)
+                # execute before the body
+                header = ast.copy_location(ast.Expr(value=_header_expr(stmt)), stmt)
+                if header.value is not None:
+                    self._flag_dead_uses(header, dead, mod, out)
+                if isinstance(stmt, ast.For):
+                    for name in _stored_names_of(stmt.target):
+                        dead.pop(name, None)
+                for block in _sub_blocks(stmt):
+                    self._scan(block, dead, donating, mod, out)
+
+    def _flag_dead_uses(self, stmt, dead, mod, out) -> None:
+        if not dead:
+            return
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in dead
+            ):
+                out.append(self.finding(
+                    mod, node,
+                    f"{node.id!r} was donated to a jitted call on line "
+                    f"{dead[node.id]} — its buffer now belongs to XLA; on "
+                    "accelerator backends this read raises, on CPU it "
+                    "aliases stale memory.  Rebind the carry "
+                    "(x = step(x, ...)) or drop the late use",
+                ))
+                dead.pop(node.id, None)
+
+    def _register_donations(self, stmt, dead, donating, mod) -> None:
+        stored = _stored_names(stmt)
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = mod.imports.qualname(node.func) or ""
+            nums = donating.get(qual.rsplit(".", 1)[-1])
+            if not nums:
+                continue
+            for i in nums:
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    name = node.args[i].id
+                    if name not in stored:  # x = f(x) rebinds: not dead
+                        dead[name] = node.lineno
+
+
+def _stored_names(stmt: ast.stmt) -> set:
+    names = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+    return names
+
+
+def _stored_names_of(target: ast.expr) -> set:
+    names = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+    return names
+
+
+def _header_expr(stmt: ast.stmt):
+    if isinstance(stmt, (ast.If, ast.While)):
+        return stmt.test
+    if isinstance(stmt, ast.For):
+        return stmt.iter
+    if isinstance(stmt, ast.With):
+        return ast.Tuple(
+            elts=[i.context_expr for i in stmt.items], ctx=ast.Load()
+        )
+    return None
+
+
+def _sub_blocks(stmt: ast.stmt):
+    if isinstance(stmt, (ast.If, ast.For, ast.While)):
+        yield stmt.body
+        yield stmt.orelse
+    elif isinstance(stmt, ast.With):
+        yield stmt.body
+    elif isinstance(stmt, ast.Try):
+        yield stmt.body
+        for h in stmt.handlers:
+            yield h.body
+        yield stmt.orelse
+        yield stmt.finalbody

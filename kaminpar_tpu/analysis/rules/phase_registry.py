@@ -1,0 +1,111 @@
+"""phase-registry: phase string literals <-> KNOWN_PHASES, both directions.
+
+The sync budget and the telemetry dashboards key on phase names (see
+``telemetry/phases.py``): a misspelled phase in a ``scoped_timer`` scope or
+a ``pull(phase=...)`` attribution silently escapes its budget assertion —
+the assertion counts a phase nobody ever pushed and trivially passes.  The
+runtime ``phases.check`` warns once per process, but only on executed
+scopes; this rule checks every literal in the package (plus bench.py, whose
+measurement fences push phases too) and, in ``finalize``, the reverse
+direction: a registered phase no source file references is dead weight that
+hides future drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from ..core import Finding, LintConfig, Rule, SourceModule
+
+# Call leaf names whose first positional string argument is a phase name.
+_PHASE_ARG0_CALLS = {
+    "scoped_timer", "scoped", "push_phase", "assert_phase_budget",
+    "phase_count", "lane_phase_count",
+}
+# sync_stats helpers that attribute through a phase= keyword.
+_PHASE_KWARG_CALLS = {"pull", "record_transfer", "assert_phase_budget"}
+
+# The registry's fallback phase is assigned, never written as a literal.
+_ASSIGNED_ONLY = {"untracked"}
+
+
+def _known_phases() -> frozenset:
+    # stdlib-only import (telemetry/phases.py imports warnings) — the
+    # analyzer stays jax-free.
+    from ...telemetry.phases import KNOWN_PHASES
+
+    return KNOWN_PHASES
+
+
+class PhaseRegistryRule(Rule):
+    name = "phase-registry"
+    description = (
+        "every scoped_timer / sync_stats phase literal must be registered "
+        "in telemetry/phases.KNOWN_PHASES, and every registered phase must "
+        "be used"
+    )
+
+    def _literals(self, mod: SourceModule):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = mod.imports.qualname(node.func) or ""
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf in _PHASE_ARG0_CALLS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    yield node, arg.value
+            if leaf in _PHASE_KWARG_CALLS:
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "phase"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        yield node, kw.value.value
+
+    def check(self, mod: SourceModule, config: LintConfig) -> List[Finding]:
+        # Registry definition site and test helpers are exempt; everything
+        # else in the package (and the extra files) is checked.
+        if mod.rel.endswith("telemetry/phases.py"):
+            return []
+        known = _known_phases()
+        out: List[Finding] = []
+        for node, name in self._literals(mod):
+            if name not in known:
+                out.append(self.finding(
+                    mod, node,
+                    f"phase {name!r} is not in the canonical registry "
+                    "(kaminpar_tpu/telemetry/phases.py) — sync-budget "
+                    "assertions and telemetry dashboards key on registered "
+                    "names; add it or fix the spelling",
+                ))
+        return out
+
+    def finalize(
+        self, modules: Sequence[SourceModule], config: LintConfig
+    ) -> List[Finding]:
+        used: Set[str] = set()
+        for mod in modules:
+            for _node, name in self._literals(mod):
+                used.add(name)
+        out: List[Finding] = []
+        registry_mod = next(
+            (m for m in modules if m.rel.endswith("telemetry/phases.py")), None
+        )
+        if registry_mod is None:
+            return out  # snippet runs don't carry the registry
+        for name in sorted(_known_phases() - _ASSIGNED_ONLY - used):
+            f = Finding(
+                rule=self.name, path=registry_mod.rel, line=1, col=0,
+                message=(
+                    f"registered phase {name!r} is never referenced by any "
+                    "source literal — stale registry entries hide future "
+                    "drift; remove it or restore its scope"
+                ),
+                snippet=f"KNOWN_PHASES: {name}",
+            )
+            f.suppressed = registry_mod.is_suppressed(self.name, 1)
+            out.append(f)
+        return out

@@ -1,0 +1,78 @@
+"""rng-discipline: randomness flows from utils/rng, nowhere else.
+
+The reproducibility contracts (restart-stable lane streams, lane-count
+invariance, per-block deterministic extension draws) all rest on ONE seed
+chain: :class:`kaminpar_tpu.utils.rng.RandomState` (thread-local, reseeded
+per replica/block) and the counter-based ``lane_key``/``lane_keys``
+derivation.  A stray ``np.random.default_rng()`` or stdlib ``random`` draw
+in a pipeline module is invisible to reseeding and silently breaks
+(seed, rep) determinism; a raw ``jax.random.key(<literal>)`` pins a stream
+that ignores the facade's seed entirely.  IO and graph generators keep
+their own seeded generators (they are outside the partitioning seed chain
+by design), so the rule covers only the device-disciplined tier.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, LintConfig, Rule, SourceModule
+
+_STDLIB_RANDOM = "random"
+
+
+class RngDisciplineRule(Rule):
+    name = "rng-discipline"
+    description = (
+        "pipeline randomness must come from utils/rng (RandomState / "
+        "lane_key); np.random and stdlib random break the seed chain"
+    )
+
+    def check(self, mod: SourceModule, config: LintConfig) -> List[Finding]:
+        if not config.is_device_module(mod):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _STDLIB_RANDOM:
+                        out.append(self.finding(
+                            mod, node,
+                            "stdlib random imported in a pipeline module — "
+                            "draws are invisible to RandomState.reseed and "
+                            "break (seed, rep) determinism; use utils/rng",
+                        ))
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0 and node.module == _STDLIB_RANDOM:
+                    out.append(self.finding(
+                        mod, node,
+                        "stdlib random imported in a pipeline module — use "
+                        "utils/rng (RandomState / next_key / lane_key)",
+                    ))
+            elif isinstance(node, ast.Attribute):
+                qual = mod.imports.qualname(node) or ""
+                if qual.startswith("numpy.random."):
+                    out.append(self.finding(
+                        mod, node,
+                        f"{qual.replace('numpy', 'np')} bypasses the seed "
+                        "chain — host draws come from "
+                        "RandomState.numpy_rng() (thread-local, reseeded "
+                        "per replica) so streams stay deterministic in "
+                        "(seed, rep)",
+                    ))
+                elif qual in ("jax.random.key", "jax.random.PRNGKey"):
+                    # flag only constructions, i.e. when this attribute is
+                    # called — bare references (e.g. docs) pass
+                    pass
+            elif isinstance(node, ast.Call):
+                qual = mod.imports.qualname(node.func) or ""
+                if qual in ("jax.random.key", "jax.random.PRNGKey"):
+                    out.append(self.finding(
+                        mod, node,
+                        "raw jax.random key construction in a pipeline "
+                        "module pins a stream outside the facade's seed "
+                        "chain — derive keys via utils/rng (next_key, "
+                        "lane_key, lane_keys)",
+                    ))
+        return out

@@ -1,0 +1,142 @@
+"""sync-discipline: host materialization must route through sync_stats.pull.
+
+The device-resident spine's contract (PR 2) is *one counted blocking
+readback per coarsening level*: every device->host materialization goes
+through :func:`kaminpar_tpu.utils.sync_stats.pull`, which counts the
+transfer (and its bytes) against the active phase.  The runtime tripwire
+(``sync_stats.tripwire``) patches the scalar-conversion dunders and the
+transfer guard raises on accelerator backends — but both only see executed
+paths.  This rule covers the whole device-disciplined tier statically:
+
+- ``np.asarray`` / ``np.array`` on a value that is (or may be) device
+  resident,
+- ``jax.device_get`` / ``block_until_ready`` anywhere,
+- ``.item()`` on a non-host receiver,
+- ``int()/float()/bool()`` coercion of a *known* device value (the
+  ``int(n_c)``-style stray the tripwire exists for).
+
+Host numpy bookkeeping is filtered by the :mod:`..hostness` classifier;
+what it cannot prove host is flagged as "possible" — mark genuinely
+host-only data with ``# kpt: ignore[sync-discipline]`` or grandfather it in
+the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, LintConfig, Rule, SourceModule
+from ..hostness import DEVICE, HOST, Hostness
+from ._walk import iter_scopes, stmt_expressions, walk_expr
+
+_MATERIALIZERS = {"numpy.asarray", "numpy.array"}
+_COERCIONS = {"int", "float", "bool"}
+
+
+class SyncDisciplineRule(Rule):
+    name = "sync-discipline"
+    description = (
+        "host-materialization primitives in pipeline/ops/serve/dist modules "
+        "must route through sync_stats.pull (counted, phase-attributed)"
+    )
+
+    def check(self, mod: SourceModule, config: LintConfig) -> List[Finding]:
+        if not config.is_device_module(mod):
+            return []
+        opts = config.options(self.name)
+        out: List[Finding] = []
+        for scope, body in iter_scopes(mod.tree):
+            tracker = Hostness(mod.imports, opts)
+            tracker.seed_from_signature(scope)
+            self._check_block(body, tracker, mod, out)
+        return out
+
+    # -- scope walk ---------------------------------------------------------
+
+    def _check_block(self, stmts, tracker: Hostness, mod, out) -> None:
+        for stmt in stmts:
+            for expr in stmt_expressions(stmt):
+                for node in walk_expr(expr):
+                    if isinstance(node, ast.Call):
+                        self._check_call(node, tracker, mod, out)
+            tracker.observe(stmt)
+            if isinstance(stmt, (ast.If, ast.For, ast.While)):
+                self._check_block(stmt.body, tracker, mod, out)
+                self._check_block(stmt.orelse, tracker, mod, out)
+            elif isinstance(stmt, ast.With):
+                self._check_block(stmt.body, tracker, mod, out)
+            elif isinstance(stmt, ast.Try):
+                self._check_block(stmt.body, tracker, mod, out)
+                for handler in stmt.handlers:
+                    self._check_block(handler.body, tracker, mod, out)
+                self._check_block(stmt.orelse, tracker, mod, out)
+                self._check_block(stmt.finalbody, tracker, mod, out)
+
+    # -- call checks --------------------------------------------------------
+
+    def _check_call(self, node: ast.Call, tracker: Hostness, mod, out) -> None:
+        qual = mod.imports.qualname(node.func)
+
+        if qual in _MATERIALIZERS and node.args:
+            cls = tracker.classify(node.args[0])
+            if cls is DEVICE:
+                out.append(self.finding(
+                    mod, node,
+                    "blocking device->host materialization outside "
+                    "sync_stats.pull — route it through sync_stats.pull("
+                    "..., phase=...) so the transfer is counted against "
+                    "the sync budget",
+                ))
+            elif cls is not HOST:
+                out.append(self.finding(
+                    mod, node,
+                    "possible un-counted host materialization (np.asarray/"
+                    "np.array on a value of unknown residency) — pull "
+                    "device values through sync_stats.pull, or mark "
+                    "host-only data with # kpt: ignore[sync-discipline]",
+                ))
+            return
+
+        if qual == "jax.device_get":
+            out.append(self.finding(
+                mod, node,
+                "jax.device_get is an un-counted blocking transfer — use "
+                "sync_stats.pull",
+            ))
+            return
+
+        if qual == "jax.block_until_ready" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            out.append(self.finding(
+                mod, node,
+                "block_until_ready serializes the dispatch pipeline — only "
+                "the timer's sync sentinel (utils/timer.py) and bench "
+                "fences may block; device code must stay async",
+            ))
+            return
+
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            if tracker.classify(node.func.value) is not HOST:
+                out.append(self.finding(
+                    mod, node,
+                    ".item() on a (possibly) device value is an implicit "
+                    "blocking scalar pull — batch it into the level's "
+                    "sync_stats.pull readback",
+                ))
+            return
+
+        if qual in _COERCIONS and len(node.args) == 1:
+            if tracker.classify(node.args[0]) is DEVICE:
+                out.append(self.finding(
+                    mod, node,
+                    f"{qual}() coercion of a device value is an implicit "
+                    "blocking scalar pull (the sync_stats tripwire class) — "
+                    "batch it into a counted sync_stats.pull",
+                ))

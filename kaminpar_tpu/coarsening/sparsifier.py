@@ -40,9 +40,14 @@ def sparsify_threshold(graph: CSRGraph, target_m: int) -> CSRGraph:
     m = graph.m
     if target_m >= m or m == 0:
         return graph
-    col = np.asarray(graph.col_idx).astype(np.int64)
-    ew = np.asarray(graph.edge_w).astype(np.int64)
-    u = np.asarray(graph.edge_u).astype(np.int64)
+    # One counted batched readback for the host threshold pass (round 12,
+    # kptlint sync-discipline: formerly three un-counted transfers).
+    from ..utils import sync_stats
+
+    col, ew, u = sync_stats.pull(graph.col_idx, graph.edge_w, graph.edge_u)
+    col = col.astype(np.int64)
+    ew = ew.astype(np.int64)
+    u = u.astype(np.int64)
 
     if target_m < 2:
         keep = np.zeros(m, dtype=bool)
@@ -62,10 +67,15 @@ def sparsify_threshold(graph: CSRGraph, target_m: int) -> CSRGraph:
     new_deg = np.bincount(u[keep], minlength=graph.n)
     new_rp = np.zeros(graph.n + 1, dtype=np.int64)
     np.cumsum(new_deg, out=new_rp[1:])
-    idt = np.asarray(graph.col_idx).dtype
-    return CSRGraph(
-        new_rp.astype(np.asarray(graph.row_ptr).dtype),
+    idt = graph.col_idx.dtype  # metadata read, no transfer
+    sg = CSRGraph(
+        new_rp.astype(graph.row_ptr.dtype),
         col[keep].astype(idt),
         graph.node_w,
-        ew[keep].astype(np.asarray(graph.edge_w).dtype),
+        ew[keep].astype(graph.edge_w.dtype),
     )
+    # Inherit the owning engine's layout mode (kptlint runtime-isolation:
+    # an unpinned graph resolves through the process default on pool
+    # workers — the PR 6 escape class).
+    sg._layout_mode = graph._layout_mode
+    return sg

@@ -625,6 +625,29 @@ class EngineRuntime:
                 _apply_cache_settings(default)
 
 
+def propagate_runtime(fn):
+    """Wrap a thread-pool worker so the *submitting* thread's active
+    :class:`EngineRuntime` is re-activated inside the worker.
+
+    Thread-local activation does not cross pool threads — the PR 6 escape
+    class: a nested-extension worker resolving layout/sync settings falls
+    through to the process default even while its owning engine's
+    activation is live on the dispatcher thread.  Every pool submission in
+    the device-disciplined tier wraps its worker with this (the per-graph
+    ``_layout_mode`` pins remain as belt-and-braces for graphs that outlive
+    the activation).  No-op (returns ``fn`` unchanged) outside any
+    activation."""
+    rt = current_runtime()
+    if rt is None:
+        return fn
+
+    def _wrapped(*args, **kwargs):
+        with rt.activate():
+            return fn(*args, **kwargs)
+
+    return _wrapped
+
+
 def reset_global_configuration() -> None:
     """Forget the memoized cache application and the recorded process
     default so the next activation re-applies and re-captures
@@ -646,26 +669,6 @@ def configure_compilation_cache(parallel: ParallelContext) -> None:
     with _cache_lock:
         _process_default_cache[0] = settings
     _apply_cache_settings(settings)
-
-
-def configure_layout_build(parallel: ParallelContext) -> None:
-    """Apply the context's layout-build backend as the process default
-    (graph/csr.py global; the KAMINPAR_TPU_LAYOUT_BUILD env var overrides).
-    Last-wins; per-run behavior is governed by the owning facade/engine's
-    :class:`EngineRuntime` activation and the per-graph pin
-    (``CSRGraph._layout_mode``), which both take precedence."""
-    from .graph.csr import set_layout_build_mode
-
-    set_layout_build_mode(parallel.device_layout_build)
-
-
-def configure_sync_timers(parallel: ParallelContext) -> None:
-    """Apply the context's sync-timers profiling switch as the process
-    default (utils/timer.py).  Last-wins; the active
-    :class:`EngineRuntime`'s flag takes precedence inside activations."""
-    from .utils import timer
-
-    timer.set_sync_mode(bool(parallel.sync_timers))
 
 
 @dataclass

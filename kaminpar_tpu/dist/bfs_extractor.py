@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils import sync_stats
 from .exchange import AXIS, ghost_exchange
 
 _INF = np.int32(2**30)
@@ -72,7 +73,7 @@ def _make_bfs_hops(mesh: Mesh, *, radius: int):
     return jax.jit(hops_fn)
 
 
-def dist_bfs_hops(mesh, dgraph, seeds, *, radius: int) -> np.ndarray:
+def dist_bfs_hops(mesh, dgraph, seeds: np.ndarray, *, radius: int) -> np.ndarray:
     """(n,) BFS hop distance from the seed set (INF where unreached within
     ``radius``)."""
     hop0 = np.full(dgraph.N, _INF, dtype=np.int32)
@@ -83,7 +84,7 @@ def dist_bfs_hops(mesh, dgraph, seeds, *, radius: int) -> np.ndarray:
     hops = fn(jnp.asarray(hop0), dgraph.edge_u.astype(jnp.int32),
               dgraph.col_loc.astype(jnp.int32), dgraph.send_idx,
               dgraph.recv_map)
-    return np.asarray(hops)[: dgraph.n]
+    return sync_stats.pull(hops, phase="dist_extract")[: dgraph.n]
 
 
 def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
@@ -98,7 +99,12 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
     if exterior not in ("exclude", "contract"):
         raise ValueError(f"unknown exterior strategy {exterior!r}")
     hops = dist_bfs_hops(mesh, dgraph, seeds, radius=radius)
-    labels_host = np.asarray(labels)[: dgraph.n].astype(np.int64)
+    # One counted readback for the label/weight inputs of the host
+    # extraction (round 12, kptlint sync-discipline).
+    labels_host, node_w = sync_stats.pull(
+        labels, dgraph.node_w, phase="dist_extract"
+    )
+    labels_host = labels_host[: dgraph.n].astype(np.int64)
     # An out-of-range label would make the np.bincount below return more
     # than k supernode weights, desynchronizing the weight vector from the
     # partition array and only failing much later inside from_edge_list.
@@ -108,7 +114,7 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
             raise ValueError(
                 f"partition labels must lie in [0, {k}); got range [{lo}, {hi}]"
             )
-    node_w = np.asarray(dgraph.node_w)[: dgraph.n].astype(np.int64)
+    node_w = node_w[: dgraph.n].astype(np.int64)
 
     reached = hops < _INF
     mapping = np.flatnonzero(reached).astype(np.int64)  # region -> global

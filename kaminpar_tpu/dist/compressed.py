@@ -67,7 +67,8 @@ class DistributedCompressedGraph:
     def shard_csr(self, s: int) -> CSRGraph:
         """Decode shard ``s`` as a CSRGraph (public convenience; the
         staging paths below use the array form)."""
-        return CSRGraph(*self._shard_arrays(s))
+        g = CSRGraph(*self._shard_arrays(s))  # kpt: ignore[runtime-isolation] — host decode convenience; no owning engine, callers pin
+        return g
 
     def to_dist_graph(self, dtype=np.int32) -> DistGraph:
         """Materialize the device-side DistGraph shard by shard (same
@@ -146,10 +147,16 @@ def compress_distributed(
     P = num_shards
     n = graph.n
     n_loc = next_pow2((n + P) // P, 8)  # distribute_graph's formula + floor
-    rp = np.asarray(graph.row_ptr).astype(np.int64)
-    col = np.asarray(graph.col_idx).astype(np.int64)
-    ew = np.asarray(graph.edge_w)
-    nw = np.asarray(graph.node_w)
+    # One counted readback for the staging split (round 12, kptlint
+    # sync-discipline: formerly four un-counted np.asarray transfers).
+    from ..utils import sync_stats
+
+    rp, col, ew, nw = sync_stats.pull(
+        graph.row_ptr, graph.col_idx, graph.edge_w, graph.node_w,
+        phase="dist_build",
+    )
+    rp = rp.astype(np.int64)
+    col = col.astype(np.int64)
 
     shards = []
     for s in range(P):

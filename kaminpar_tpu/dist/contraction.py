@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.segment import run_starts2
+from ..utils import sync_stats
 from ..utils.intmath import next_pow2
 from .exchange import (
     AXIS,
@@ -264,14 +265,18 @@ def contract_dist_clustering(
             break
         cap_q2 = min(cap_q2 * 2, n_loc + graph.g_loc)
 
-    cap = next_pow2(int(np.max(np.asarray(counts))), 8)
-    cap_w = next_pow2(int(np.max(np.asarray(wcounts))), 8)
+    # Counted batched readback of the staging counts (round 12, kptlint
+    # sync-discipline: these were un-counted np.asarray strays).
+    counts_h, wcounts_h = sync_stats.pull(counts, wcounts)
+    cap = next_pow2(int(counts_h.max()), 8)
+    cap_w = next_pow2(int(wcounts_h.max()), 8)
 
     agg_u, agg_v, agg_w, m_c_loc, node_w_c = _s3(
         mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts,
         num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
     )
-    m_loc_c = next_pow2(int(np.max(np.asarray(m_c_loc))), 8)
+    m_c_loc = sync_stats.pull(m_c_loc)
+    m_loc_c = next_pow2(int(m_c_loc.max()), 8)
     m_loc_c = min(m_loc_c, Pn * cap)  # aggregation buffer bound (ADVICE r1)
 
     edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
@@ -283,7 +288,8 @@ def contract_dist_clustering(
     return coarse, coarse_of, n_c
 
 
-def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c, *,
+def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c,
+                     m_c_loc: np.ndarray, n_c, *,
                      n_loc_c: int, m_loc_c: int, num_shards: int) -> DistGraph:
     """Host tail shared by global and local contraction: localize edge
     targets + build the coarse ghost routing (O(m_c) host work on a
@@ -291,10 +297,12 @@ def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c, *,
     shard-local (cu_l subtraction in the aggregation bodies) — do not
     localize them again."""
     Pn = num_shards
-    m_total = int(np.sum(np.asarray(m_c_loc)))
-    eu_l = np.asarray(edge_u_g).reshape(Pn, m_loc_c)
-    cv_g = np.asarray(col_g).reshape(Pn, m_loc_c)
-    w_np = np.asarray(edge_w_c).reshape(Pn, m_loc_c)
+    m_total = int(m_c_loc.sum())  # pulled by the caller alongside the caps
+    # One counted batched readback for the host assembly inputs.
+    eu_l, cv_g, w_np = sync_stats.pull(edge_u_g, col_g, edge_w_c)
+    eu_l = eu_l.reshape(Pn, m_loc_c)
+    cv_g = cv_g.reshape(Pn, m_loc_c)
+    w_np = w_np.reshape(Pn, m_loc_c)
     dtype = eu_l.dtype
     col_shards = [cv_g[s] for s in range(Pn)]
     valid_shards = [w_np[s] > 0 for s in range(Pn)]
@@ -518,7 +526,7 @@ def contract_local_clustering(
             f"{int(nonlocal_count)} nodes have non-local cluster ids; use "
             "contract_dist_clustering for clusterings that span shards"
         )
-    counts = np.asarray(counts)
+    counts = sync_stats.pull(counts)
     n_c = int(counts.sum())
     n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
     r_loc = next_pow2(int(counts.max()), 8)
@@ -529,14 +537,16 @@ def contract_local_clustering(
         graph.edge_w, graph.send_idx, graph.recv_map,
         n_loc=n_loc, n_loc_c=n_loc_c, r_loc=r_loc, n_real=graph.n,
     )
-    cap = next_pow2(int(np.max(np.asarray(ecounts))), 8)
-    cap_w = next_pow2(int(np.max(np.asarray(wcounts))), 8)
+    ecounts_h, wcounts_h = sync_stats.pull(ecounts, wcounts)
+    cap = next_pow2(int(ecounts_h.max()), 8)
+    cap_w = next_pow2(int(wcounts_h.max()), 8)
 
     agg_u, agg_v, agg_w, m_c_loc, node_w_c = _s3(
         mesh, s_cu, s_cv, s_w, ecounts, w_keys, w_vals, wcounts,
         num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
     )
-    m_loc_c = next_pow2(int(np.max(np.asarray(m_c_loc))), 8)
+    m_c_loc = sync_stats.pull(m_c_loc)
+    m_loc_c = next_pow2(int(m_c_loc.max()), 8)
     m_loc_c = min(m_loc_c, Pn * cap)
     edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
 

@@ -18,6 +18,7 @@ from functools import lru_cache, partial
 
 from jax.sharding import PartitionSpec as P
 
+from ..utils import sync_stats
 from .exchange import ghost_exchange
 from .metrics import dist_block_weights
 
@@ -51,8 +52,9 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
        given (reference debug.cc:122 checks the replicated tables).
     """
     problems = []
-    lab = np.asarray(labels)
-    node_w = np.asarray(graph.node_w)
+    # One counted readback for the label + weight sweep (round 12, kptlint
+    # sync-discipline: these were un-counted np.asarray transfers).
+    lab, node_w = sync_stats.pull(labels, graph.node_w, phase="dist_validation")
     real = node_w > 0
 
     if real.any():
@@ -63,8 +65,9 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
             )
 
     # ghost consistency through the actual exchange program
-    gl = np.asarray(
-        _make_ghost_reader(mesh)(labels, graph.send_idx, graph.recv_map)
+    gl = sync_stats.pull(
+        _make_ghost_reader(mesh)(labels, graph.send_idx, graph.recv_map),
+        phase="dist_validation",
     )
     gl = gl.reshape(graph.num_shards, graph.g_loc)
     for s in range(graph.num_shards):
@@ -79,12 +82,13 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
                 f"shard {s}: {int(bad.sum())} ghost labels diverge from owners"
             )
 
+    # dist_block_weights already returns a pulled host array.
     bw = dist_block_weights(mesh, labels, graph, k=k)
     direct = np.bincount(lab[real], weights=node_w[real], minlength=k)
-    if not np.array_equal(np.asarray(bw), direct.astype(np.asarray(bw).dtype)):
+    if not np.array_equal(bw, direct.astype(bw.dtype)):
         problems.append("device block weights diverge from direct recount")
     if max_block_weights is not None:
-        over = np.flatnonzero(np.asarray(bw) > np.asarray(max_block_weights))
+        over = np.flatnonzero(bw > np.asarray(max_block_weights))  # kpt: ignore[sync-discipline] — caps are host np
         if len(over):
             problems.append(f"blocks over cap: {over.tolist()}")
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..utils import RandomState
+from ..utils import RandomState, sync_stats
 from ..utils.intmath import next_pow2
 from ..utils.logger import Logger, OutputLevel
 from .contraction import contract_dist_clustering, project_partition_up
@@ -122,9 +122,7 @@ def dist_extend_partition(mesh, part_dev, dgraph, cur_k: int, target_k: int,
                          cur.send_idx, cur.recv_map)
         mg = cur._replace(edge_w=masked)
         if total_w is None:
-            total_w = int(np.asarray(
-                jax.device_get(jnp.sum(cur.node_w))
-            ))
+            total_w = int(sync_stats.pull(jnp.sum(cur.node_w)))
         max_cw = max(
             int(eps * total_w / max(min(cur.n // max(C, 1), target_k), 2)), 1
         )
@@ -159,7 +157,7 @@ def dist_extend_partition(mesh, part_dev, dgraph, cur_k: int, target_k: int,
     comm_host = np.asarray(comm)[: cur.n].astype(np.int32)
     ext_ctx = _copy.deepcopy(ctx)
     ext_ctx.partition.k = len(final_bw)
-    ext_ctx.partition.max_block_weights = np.asarray(final_bw, dtype=np.int64)
+    ext_ctx.partition.max_block_weights = np.asarray(final_bw, dtype=np.int64)  # kpt: ignore[sync-discipline] — final_bw is host np
     part_host = _extend_partition_host(
         host, comm_host, cur_k, target_k, ext_ctx
     )
@@ -169,7 +167,7 @@ def dist_extend_partition(mesh, part_dev, dgraph, cur_k: int, target_k: int,
 
     cap = jnp.asarray(
         intermediate_block_weights(
-            np.asarray(final_bw, dtype=np.int64), target_k
+            np.asarray(final_bw, dtype=np.int64), target_k  # kpt: ignore[sync-discipline] — final_bw is host np
         ),
         dtype=dgraph.dtype,
     )

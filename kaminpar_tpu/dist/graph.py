@@ -79,10 +79,17 @@ class DistGraph(NamedTuple):
         numpy arrays — gathers the device shards, localizes ghost slots via
         ghost_global.  Shared by replicate-to-host and the BFS extractor
         (keep the subtle slot->global localization in ONE place)."""
+        from ..utils import sync_stats
+
         srcs, dsts, ws = [], [], []
-        eu = np.asarray(self.edge_u).reshape(self.num_shards, self.m_loc)
-        cl = np.asarray(self.col_loc).reshape(self.num_shards, self.m_loc)
-        ew = np.asarray(self.edge_w).reshape(self.num_shards, self.m_loc)
+        # One counted readback for the full-edge gather (round 12, kptlint
+        # sync-discipline): the replicate/BFS paths pay this knowingly.
+        eu, cl, ew = sync_stats.pull(
+            self.edge_u, self.col_loc, self.edge_w, phase="dist_extract"
+        )
+        eu = eu.reshape(self.num_shards, self.m_loc)
+        cl = cl.reshape(self.num_shards, self.m_loc)
+        ew = ew.reshape(self.num_shards, self.m_loc)
         for s in range(self.num_shards):
             real = ew[s] > 0
             srcs.append(
@@ -142,11 +149,18 @@ def distribute_graph(
     vtxdist); balanced *edge* distribution would permute by degree first —
     callers can pre-permute with graph.csr.rearrange_by_degree_buckets.
     """
+    from ..utils import sync_stats
+
     P = num_shards
-    rp = np.asarray(graph.row_ptr)
-    col = np.asarray(graph.col_idx).astype(dtype)
-    ew = np.asarray(graph.edge_w).astype(dtype)
-    nw = np.asarray(graph.node_w).astype(dtype)
+    # The staging split reads the whole CSR once; counted as one batched
+    # readback (zero-copy on the CPU backend, a real transfer on devices).
+    rp, col, ew, nw = sync_stats.pull(
+        graph.row_ptr, graph.col_idx, graph.edge_w, graph.node_w,
+        phase="dist_build",
+    )
+    col = col.astype(dtype)
+    ew = ew.astype(dtype)
+    nw = nw.astype(dtype)
     n, m = graph.n, graph.m
 
     n_loc = _next_pow2((n + P) // P)  # ceil((n+1)/P) so N > n

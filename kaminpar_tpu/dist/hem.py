@@ -129,4 +129,6 @@ def dist_hem_cluster(mesh, key, graph, max_cw, *, num_rounds: int = 5):
             break
         total = total + matched
     labels = jnp.minimum(match, jnp.arange(N, dtype=graph.dtype))
-    return labels, int(total) // 2
+    from ..utils import sync_stats
+
+    return labels, int(sync_stats.pull(total)) // 2

@@ -569,9 +569,9 @@ def dist_color(mesh: Mesh, graph, *, return_forced: bool = False):
     )
     colors = jnp.maximum(raw, 0)
     if return_forced:
-        import numpy as np
+        from ..utils import sync_stats
 
-        return colors, int(np.asarray((raw < 0).sum()))
+        return colors, int(sync_stats.pull((raw < 0).sum()))
     return colors
 
 
@@ -639,7 +639,9 @@ def dist_clp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
     import numpy as np
 
     colors, forced = dist_color(mesh, graph, return_forced=True)
-    nc = int(np.asarray(colors).max()) + 1
+    from ..utils import sync_stats
+
+    nc = int(sync_stats.pull(jnp.max(colors))) + 1
     if forced > 0:
         # Round cap left stragglers at color 0: the coloring may be
         # improper, so color classes are no longer independent sets and

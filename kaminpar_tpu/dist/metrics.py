@@ -63,19 +63,26 @@ def make_dist_metrics(mesh: Mesh, *, k: int):
 
 def dist_edge_cut(mesh: Mesh, labels, graph, *, k: int) -> int:
     """Global edge cut of a sharded partition (one device program)."""
+    from ..utils import sync_stats
+
     cut2, _ = make_dist_metrics(mesh, k=k)(
         labels, graph.node_w, graph.edge_u, graph.col_loc, graph.edge_w,
         graph.send_idx, graph.recv_map,
     )
-    return int(cut2) // 2
+    # int(cut2) was an un-counted implicit scalar pull (round 12).
+    return int(sync_stats.pull(cut2, phase="dist_metrics")) // 2
 
 
 def dist_block_weights(mesh: Mesh, labels, graph, *, k: int) -> np.ndarray:
+    from ..utils import sync_stats
+
     _, bw = make_dist_metrics(mesh, k=k)(
         labels, graph.node_w, graph.edge_u, graph.col_loc, graph.edge_w,
         graph.send_idx, graph.recv_map,
     )
-    return np.asarray(bw)
+    # Counted readback (round 12): the (k,) weight table leaves the device
+    # exactly once per metrics call.
+    return sync_stats.pull(bw, phase="dist_metrics")
 
 
 def dist_imbalance(mesh: Mesh, labels, graph, *, k: int) -> float:
@@ -87,4 +94,4 @@ def dist_imbalance(mesh: Mesh, labels, graph, *, k: int) -> float:
 
 def dist_is_feasible(mesh: Mesh, labels, graph, max_block_weights, *, k: int) -> bool:
     bw = dist_block_weights(mesh, labels, graph, k=k)
-    return bool((bw <= np.asarray(max_block_weights)).all())
+    return bool((bw <= np.asarray(max_block_weights)).all())  # kpt: ignore[sync-discipline] — caps are host np

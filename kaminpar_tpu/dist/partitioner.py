@@ -25,7 +25,7 @@ from jax.sharding import Mesh
 from ..context import Context
 from ..graph.csr import CSRGraph, from_edge_list
 from ..graph import metrics
-from ..utils import RandomState
+from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .balancer import dist_balance
@@ -235,8 +235,9 @@ class DKaMinPar:
                     rep_ctx.partition.max_block_weights,
                     perfect + int(coarse_host.max_node_weight),
                 )
-                cand = np.asarray(
-                    create_partitioner(rep_ctx, coarse_host).partition().partition
+                cand = sync_stats.pull(
+                    create_partitioner(rep_ctx, coarse_host).partition().partition,
+                    phase="dist_initial_partitioning",
                 ).astype(np.int32)
                 return cand, metrics.edge_cut(coarse_host, cand)
 
@@ -253,8 +254,12 @@ class DKaMinPar:
                 # Always run reps in worker threads — even reps == 1 —
                 # so the reseed never touches the main thread's stream.
                 workers = min(reps, max(_os.cpu_count() or 1, 1))
+                from ..context import propagate_runtime
+
                 with ThreadPoolExecutor(max_workers=workers) as pool:
-                    results = list(pool.map(one_rep, range(reps)))
+                    results = list(
+                        pool.map(propagate_runtime(one_rep), range(reps))
+                    )
             finally:
                 timer.enable()
             # Mesh splitting (deep_multilevel.cc:80-96 / replicator.cc):
@@ -313,7 +318,7 @@ class DKaMinPar:
                     part_dev, level.graph, cur_k, k, final_bw
                 )
 
-        out = np.asarray(part_dev)[: graph.n]
+        out = sync_stats.pull(part_dev)[: graph.n]
         if Logger.level.value >= OutputLevel.EXPERIMENT.value:
             # (dist_edge_cut computes the identical value on device — used
             # when the graph only exists sharded; here the host copy is free)
@@ -361,7 +366,7 @@ class DKaMinPar:
                 from ..partitioning.deep import extend_partition
 
                 host = self._replicate_to_host(dgraph)
-                part_host = np.asarray(part_dev)[: dgraph.n].astype(np.int32)
+                part_host = sync_stats.pull(part_dev)[: dgraph.n].astype(np.int32)
                 import copy as _copy
 
                 ext_ctx = _copy.deepcopy(self.ctx)
@@ -380,7 +385,7 @@ class DKaMinPar:
                 part_dev = jnp.asarray(full)
 
         cap = jnp.asarray(
-            intermediate_block_weights(np.asarray(final_bw, dtype=np.int64), cur_k),
+            intermediate_block_weights(np.asarray(final_bw, dtype=np.int64), cur_k),  # kpt: ignore[sync-discipline] — final_bw is host np
             dtype=dgraph.dtype,
         )
         part_dev = self._refine(part_dev, dgraph, cap, cur_k)
@@ -463,7 +468,7 @@ class DKaMinPar:
     def _replicate_to_host(self, dg: DistGraph) -> CSRGraph:
         """replicate_graph_everywhere analog: gather the coarse graph off the
         mesh and rebuild a host CSRGraph (reference: replicator.h:26)."""
-        node_w = np.asarray(dg.node_w)[: dg.n]
+        node_w = sync_stats.pull(dg.node_w, phase="dist_extract")[: dg.n]
         src, dst, ww = dg.edges_global_host()
         edges = np.stack([src, dst], axis=1)
         return from_edge_list(

@@ -116,6 +116,11 @@ def refine_replicated(mesh: Mesh, key, parts_R: np.ndarray, coarse_host,
         key, labels_dev, args[0], args[1], args[2], args[3],
         jnp.asarray(max_w), args[4], args[5],
     )
-    cuts = np.asarray(cuts2) // 2
+    from ..utils import sync_stats
+
+    # Two counted readbacks: the tiny (R,) cut vector first, then ONLY the
+    # winning label row — pulling the whole (R, N) stack would be an R-fold
+    # bandwidth regression on the best-of-R path.
+    cuts = sync_stats.pull(cuts2) // 2
     best = int(np.argmin(cuts))
-    return np.asarray(out_labels[best])[: coarse_host.n], cuts
+    return sync_stats.pull(out_labels[best])[: coarse_host.n], cuts

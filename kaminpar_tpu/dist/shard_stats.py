@@ -100,13 +100,20 @@ def collect_graph_stats(dgraph) -> ShardStats:
         dtype=np.float64,
     )
     st.record("owned_nodes", owned)
-    edge_w = np.asarray(dgraph.edge_w).reshape(P, dgraph.m_loc)
+    # One counted readback for the work table's device inputs (round 12,
+    # kptlint sync-discipline: these were un-counted np.asarray transfers).
+    from ..utils import sync_stats
+
+    edge_w, send = sync_stats.pull(
+        dgraph.edge_w, dgraph.send_idx, phase="dist_stats"
+    )
+    edge_w = edge_w.reshape(P, dgraph.m_loc)
     st.record("owned_edges", (edge_w > 0).sum(axis=1))
     st.record("ghost_nodes", [len(g) for g in dgraph.ghost_global])
     # interface = owned nodes referenced by at least one other shard
     # (send_idx rows (t*P+s) hold the slots shard t sends to shard s;
     # pad slots hold n_loc).
-    send = np.asarray(dgraph.send_idx).reshape(P, P, dgraph.cap_g)
+    send = send.reshape(P, P, dgraph.cap_g)
     iface = [
         len(np.unique(send[t][send[t] < n_loc])) for t in range(P)
     ]

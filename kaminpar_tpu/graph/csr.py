@@ -46,7 +46,8 @@ def _next_bucket(x: int, minimum: int = 256) -> int:
 # level's single batched readback — no bulk transfer), or "auto" (device
 # on accelerator backends).  Owned per facade/engine by the active
 # EngineRuntime (ParallelContext.device_layout_build); set_layout_build_mode
-# / context.configure_layout_build() set the process default, and
+# sets the process default (offline entry points only — kptlint's
+# runtime-isolation rule bans it from pipeline code), and
 # KAMINPAR_TPU_LAYOUT_BUILD overrides everything.
 _layout_build_mode = "auto"
 

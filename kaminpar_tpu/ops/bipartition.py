@@ -464,6 +464,15 @@ def pool_bipartition_device(
 
     t0 = time.perf_counter()
     g = from_numpy_csr(row_ptr, col_idx, node_w, edge_w)
+    # Pin the owning engine's layout mode through the EngineRuntime
+    # accessor: this runs on extension pool workers where thread-local
+    # activation is otherwise invisible (kptlint runtime-isolation; the
+    # pool submission sites wrap workers in context.propagate_runtime, and
+    # the pin keeps the graph correct even if it outlives the activation).
+    from ..context import current_runtime
+
+    rt = current_runtime()
+    g._layout_mode = rt.layout_build if rt is not None else None
     pv = g.padded()
     idt = pv.node_w.dtype
     keys = method_lane_keys(seed, methods)

@@ -107,9 +107,10 @@ def color_graph(key, edge_u, col_idx, node_mask, *, n: int, max_rounds: int = 64
 
 
 def num_colors(colors, node_mask) -> int:
-    import numpy as np
+    from ..utils import sync_stats
 
-    c = np.asarray(colors)[np.asarray(node_mask)]
+    colors_h, mask_h = sync_stats.pull(colors, node_mask)
+    c = colors_h[mask_h]
     return int(c.max()) + 1 if len(c) else 1
 
 

@@ -19,6 +19,7 @@ from ..factories import create_refiner
 from ..graph.csr import CSRGraph
 from ..graph.partitioned import PartitionedGraph
 from ..initial.bipartitioner import (
+    HostCSR,
     extract_all_subgraphs,
     recursive_bipartition,
     resolve_ip_backend,
@@ -128,9 +129,14 @@ def _extend_partition_host(
         timer.disable()
         try:
             # Pool even at workers == 1: the reseed must land in a worker
-            # thread's stream, never the caller's.
+            # thread's stream, never the caller's.  propagate_runtime
+            # re-activates the submitting thread's EngineRuntime inside the
+            # workers (thread-local activation does not cross pool threads
+            # — the PR 6 escape class).
+            from ..context import propagate_runtime
+
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                results = list(pool.map(run_job, jobs))
+                results = list(pool.map(propagate_runtime(run_job), jobs))
         finally:
             timer.enable()
     for nodes, subpart in results:
@@ -138,7 +144,9 @@ def _extend_partition_host(
     return out
 
 
-def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.ndarray:
+def _nested_partition(
+    sub: HostCSR, sub_k: int, budgets: np.ndarray, ctx: Context
+) -> np.ndarray:
     """Partition one extension subgraph with a nested deep pipeline.
 
     Constructs the partitioner directly (not through the KaMinPar facade,
@@ -167,13 +175,18 @@ def _nested_partition(sub, sub_k: int, budgets: np.ndarray, ctx: Context) -> np.
     reps = max(ctx.initial_partitioning.nested_extension_reps, 1)
     if reps == 1:
         p = DeepMultilevelPartitioner(sub_ctx, g).partition()
-        return np.asarray(p.partition).astype(np.int32)
+        return sync_stats.pull(
+            p.partition, phase="extend_partition"
+        ).astype(np.int32)
     best_part, best_score = None, None
     for _ in range(reps):
         p = DeepMultilevelPartitioner(sub_ctx, g).partition()
         score = (not p.is_feasible(), p.edge_cut())
         if best_score is None or score < best_score:
-            best_part, best_score = np.asarray(p.partition).astype(np.int32), score
+            best_part = sync_stats.pull(
+                p.partition, phase="extend_partition"
+            ).astype(np.int32)
+            best_score = score
     return best_part
 
 
@@ -204,7 +217,8 @@ class DeepMultilevelPartitioner:
         self.communities = communities
         self.communities_k = communities_k
 
-    def _restrict(self, p_graph: PartitionedGraph, pre_part, cur_k: int, communities):
+    def _restrict(self, p_graph: PartitionedGraph, pre_part: np.ndarray,
+                  cur_k: int, communities):
         """Restricted v-cycle refinement: revert moves that crossed the
         previous cycle's block boundaries (reference:
         restrict_vcycle_refinement, vcycle_deep_multilevel.cc:132-152)."""
@@ -329,7 +343,10 @@ class DeepMultilevelPartitioner:
                 # v-cycle: the coarsest partition is the (projected) previous
                 # cycle's partition; extension grows it toward k on the way up.
                 cur_k = self.communities_k
-                part = np.asarray(coarsener.current_communities, dtype=np.int32)
+                part = sync_stats.pull(
+                    coarsener.current_communities,
+                    phase="initial_partitioning",
+                ).astype(np.int32)
                 with scoped_timer("initial_partitioning"):
                     pass
             else:

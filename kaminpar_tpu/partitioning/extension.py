@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils import sync_stats
 from ..utils.logger import Logger, OutputLevel
 from .partition_utils import intermediate_block_weights, split_offsets
 
@@ -61,7 +62,9 @@ def extend_partition_device(graph, part, cur_k: int, new_k: int, ctx) -> np.ndar
     )
     coarsener.coarsen(new_k, ctx.partition.epsilon, target_n)
     coarsest = coarsener.current_graph
-    coarse_comm = np.asarray(coarsener.current_communities, dtype=np.int32)
+    coarse_comm = sync_stats.pull(
+        coarsener.current_communities, phase="extend_partition"
+    ).astype(np.int32)
     Logger.log(
         f"  device-ext: n={graph.n} coarsened to {coarsest.n} "
         f"({coarsener.num_levels} nested levels) for k {cur_k}->{new_k}",
@@ -84,7 +87,7 @@ def extend_partition_device(graph, part, cur_k: int, new_k: int, ctx) -> np.ndar
         if coarsener.num_levels == 0:
             break
         part_dev = coarsener.uncoarsen(part_dev)
-    return np.asarray(part_dev, dtype=np.int32)
+    return sync_stats.pull(part_dev, phase="extend_partition").astype(np.int32)
 
 
 def _restricted_refine(graph, part, comm, new_k, parent_of_new, inter_bw, ctx):

@@ -15,6 +15,7 @@ from ..context import Context, PartitioningMode
 from ..graph.csr import CSRGraph, from_numpy_csr
 from ..graph.partitioned import PartitionedGraph
 from ..initial.bipartitioner import extract_subgraph
+from ..utils import sync_stats
 from ..utils.timer import scoped_timer
 
 
@@ -33,7 +34,8 @@ class RBMultilevelPartitioner:
         # Final-k minimums do not apply to intermediate bisections.
         sub_ctx.partition.min_block_weights = None
         p = KWayMultilevelPartitioner(sub_ctx, graph).partition()
-        return np.asarray(p.partition)
+        # Counted readback of the bisection labels (round 12, kptlint).
+        return sync_stats.pull(p.partition)
 
     def _recurse(self, graph: CSRGraph, k: int, max_bw: np.ndarray) -> np.ndarray:
         if k <= 1 or graph.n == 0:
@@ -58,6 +60,9 @@ class RBMultilevelPartitioner:
             sub, nodes = extract_subgraph(host, bi, side)
             if kk > 1:
                 subgraph = from_numpy_csr(sub.row_ptr, sub.col_idx, sub.node_w, sub.edge_w)
+                # Inherit layout ownership (kptlint runtime-isolation; the
+                # PR 6 pool-worker escape class).
+                subgraph._layout_mode = graph._layout_mode
                 subpart = self._recurse(subgraph, kk, max_bw[offset : offset + kk])
             else:
                 subpart = np.zeros(sub.n, dtype=np.int32)

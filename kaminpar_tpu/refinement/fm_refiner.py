@@ -47,7 +47,7 @@ import numpy as np
 
 from ..context import FMContext
 from ..graph.partitioned import PartitionedGraph
-from ..utils import RandomState
+from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
 from .refiner import Refiner
@@ -345,17 +345,23 @@ class FMRefiner(Refiner):
             )
             return p_graph
         with scoped_timer("fm_refinement"):
-            row_ptr = np.asarray(g.row_ptr).astype(np.int64)
+            # ONE counted batched readback for the host pass's inputs
+            # (round 12, kptlint sync-discipline: formerly five un-counted
+            # np.asarray transfers).
+            rp_d, col_d, ew_d, nw_d, part_d = sync_stats.pull(
+                g.row_ptr, g.col_idx, g.edge_w, g.node_w, p_graph.partition
+            )
+            row_ptr = rp_d.astype(np.int64)
             # 32-bit adjacency halves the host footprint at the 4M-node scale
             # the sparse table exists for (ids and edge weights are 32-bit in
             # the reference's default build too, CMakeLists.txt:71-79).
-            col_idx = np.asarray(g.col_idx).astype(np.int32, copy=False)
-            ew64 = np.asarray(g.edge_w).astype(np.int64)
+            col_idx = col_d.astype(np.int32, copy=False)
+            ew64 = ew_d.astype(np.int64)
             small_w = int(ew64.sum()) < 2**31
             edge_w = ew64.astype(np.int32) if small_w else ew64
-            node_w = np.asarray(g.node_w).astype(np.int64)
+            node_w = nw_d.astype(np.int64)
             u_arr = np.repeat(np.arange(g.n, dtype=np.int32), np.diff(row_ptr))
-            part = np.asarray(p_graph.partition).astype(np.int32).copy()
+            part = part_d.astype(np.int32).copy()
             max_bw = np.asarray(p_graph.max_block_weights, dtype=np.int64)
             k = p_graph.k
             bw = np.bincount(part, weights=node_w, minlength=k).astype(np.int64)

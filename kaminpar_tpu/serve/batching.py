@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..graph.csr import CSRGraph, _next_bucket
+from ..utils import sync_stats
 
 
 class ShapeCell(NamedTuple):
@@ -73,7 +74,7 @@ def pack_graphs(graphs: Sequence[CSRGraph]) -> PackedBatch:
     bucket ladder like any other graph."""
     if not graphs:
         raise ValueError("cannot pack an empty batch")
-    use_64 = any(np.asarray(g.row_ptr).dtype == np.int64 for g in graphs)
+    use_64 = any(g.row_ptr.dtype == np.int64 for g in graphs)  # metadata read
     idt = np.int64 if use_64 else np.int32
     n_off = np.zeros(len(graphs) + 1, dtype=np.int64)
     m_off = np.zeros(len(graphs) + 1, dtype=np.int64)
@@ -88,20 +89,28 @@ def pack_graphs(graphs: Sequence[CSRGraph]) -> PackedBatch:
     for i, g in enumerate(graphs):
         ns, ne = int(n_off[i]), int(n_off[i + 1])
         ms, me = int(m_off[i]), int(m_off[i + 1])
-        row_ptr[ns + 1 : ne + 1] = np.asarray(g.row_ptr)[1:] + ms
-        col_idx[ms:me] = np.asarray(g.col_idx) + ns
-        node_w[ns:ne] = np.asarray(g.node_w)
-        edge_w[ms:me] = np.asarray(g.edge_w)
+        # ONE counted batched readback per member graph (round 12, kptlint
+        # sync-discipline: formerly four un-counted np.asarray transfers;
+        # zero-copy on the CPU backend, a real pull on accelerators).
+        rp_h, col_h, nw_h, ew_h = sync_stats.pull(
+            g.row_ptr, g.col_idx, g.node_w, g.edge_w, phase="serve_pack"
+        )
+        row_ptr[ns + 1 : ne + 1] = rp_h[1:] + ms
+        col_idx[ms:me] = col_h + ns
+        node_w[ns:ne] = nw_h
+        edge_w[ms:me] = ew_h
         node_gid[ns:ne] = i
         edge_gid[ms:me] = i
-    return PackedBatch(
-        CSRGraph(row_ptr, col_idx, node_w, edge_w),
-        n_off, m_off, node_gid, edge_gid,
-    )
+    union = CSRGraph(row_ptr, col_idx, node_w, edge_w)
+    # The union inherits the first member's layout ownership (all members
+    # of a batch belong to the same engine; kptlint runtime-isolation).
+    union._layout_mode = getattr(graphs[0], "_layout_mode", None)
+    return PackedBatch(union, n_off, m_off, node_gid, edge_gid)
 
 
-def unpack_partition(labels, node_offsets: np.ndarray) -> List[np.ndarray]:
-    """Split a union-node-space label array back into per-graph arrays."""
+def unpack_partition(labels: np.ndarray, node_offsets: np.ndarray) -> List[np.ndarray]:
+    """Split a union-node-space label array back into per-graph arrays
+    (host arrays in, host arrays out — the engine pulls before unpacking)."""
     labels = np.asarray(labels)
     return [
         labels[int(node_offsets[i]) : int(node_offsets[i + 1])]
@@ -173,7 +182,7 @@ def batched_metrics(
     nb = max(b, int(pad_to or 0))
     pv = packed.union.padded()
     labels = np.zeros(pv.n_pad, dtype=np.int32)
-    labels[: pv.n] = np.concatenate([np.asarray(p) for p in parts])
+    labels[: pv.n] = np.concatenate(list(parts))
     egid = np.zeros(pv.m_pad, dtype=np.int32)
     egid[: pv.m] = packed.edge_gid
     ngid = np.zeros(pv.n_pad, dtype=np.int32)

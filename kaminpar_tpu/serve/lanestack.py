@@ -66,6 +66,7 @@ from ..partitioning.partition_utils import (
 )
 from ..telemetry import probes
 from ..utils import RandomState, sync_stats
+from ..utils import rng
 from ..utils.platform import host_pool_workers
 from ..utils.timer import scoped_timer
 
@@ -88,7 +89,7 @@ class LaneChain:
 
     def __init__(self, seed: int):
         self.seed = int(seed)
-        self.key = jax.random.key(int(seed))
+        self.key = rng.seed_key(seed)
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -278,14 +279,21 @@ def _map_lanes(fn, L: int, pool=None, disable_timers: bool = False) -> list:
     tbb task arena (and as deep._extend_partition_host does)."""
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..context import propagate_runtime
+
+    # Workers re-activate the dispatcher thread's EngineRuntime: the
+    # per-lane IP/extension stages resolve layout/sync settings, and
+    # thread-local activation does not cross pool threads (PR 6 class).
+    wfn = propagate_runtime(fn)
+
     def _run() -> list:
         if pool is not None:
-            return list(pool.map(fn, range(L)))
+            return list(pool.map(wfn, range(L)))
         workers = host_pool_workers(L)
         if workers <= 1:
             return [fn(i) for i in range(L)]
         with ThreadPoolExecutor(max_workers=workers) as tpool:
-            return list(tpool.map(fn, range(L)))
+            return list(tpool.map(wfn, range(L)))
 
     if disable_timers:
         from ..utils.timer import Timer
@@ -740,7 +748,7 @@ class LaneStackRunner:
         # --- overload balancer (balancer.py round-loop replica) -----------
         active = [True] * c.L
         lab = labels
-        dummy = jax.random.key(0)
+        dummy = rng.seed_key(0)
         for _ in range(ctx.refinement.balancer.max_num_rounds):
             keys = jnp.stack([
                 lane.chain.next_key() if active[i] else dummy
@@ -812,7 +820,7 @@ class LaneStackRunner:
             rp[: n_i + 1], col[:m_i], nw[:n_i], ew[:m_i], edge_u=eu[:m_i]
         )
         g._padded = PaddedView(rp, col, nw, ew, eu, n_i, m_i)
-        g._deg_hist = np.asarray(level.hist[i])
+        g._deg_hist = level.hist[i]  # host (12,) histogram (see _Level)
         g._layout_mode = lane.ctx.parallel.device_layout_build
         g._total_node_weight = lane.tnw
         g._max_node_weight = int(level.max_nw[i])
@@ -930,7 +938,8 @@ class LaneStackRunner:
             # The facade's exact re-integration helper (graph/isolated.py).
             part = assign_isolated_nodes(
                 lane.graph.n, self.k, lane.keep, lane.isolated, work_part,
-                lane.work_host["node_w"], np.asarray(lane.graph.node_w),
+                lane.work_host["node_w"],
+                sync_stats.pull(lane.graph.node_w, phase="serve_lanestack"),
                 lane.caps,
             )
         from ..utils.assertions import LIGHT, kassert

@@ -55,6 +55,17 @@ AUX_PHASES = (
     "lanestack_ip",
     "lanestack_refinement",
     "lanestack_extend",
+    # Dist-tier helper readbacks (round 12, kptlint sync-discipline): the
+    # previously un-counted np.asarray sites in dist/{metrics,debug,
+    # shard_stats,graph,bfs_extractor}.py now route through sync_stats.pull
+    # under these phases, so the future sharded pipeline inherits accounted
+    # transfers (ROADMAP item 1's per-shard accounting extends them).
+    "dist_build",       # host->device staging views during DistGraph build
+    "dist_metrics",     # cut/block-weight reductions pulled for reporting
+    "dist_validation",  # debug.validate_partition consistency sweeps
+    "dist_stats",       # shard_stats work-table collection
+    "dist_extract",     # BFS-ball subgraph extraction readbacks
+    "serve_pack",       # batching.pack_graphs per-member CSR readbacks
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

@@ -17,9 +17,10 @@ def main(argv=None) -> int:
     if name not in tools.REGISTRY:
         print(f"unknown tool '{name}'; available: {sorted(tools.REGISTRY)}")
         return 1
-    from ..utils.platform import prefer_working_backend
+    if name != "lint":  # lint is pure-AST and must stay jax-free
+        from ..utils.platform import prefer_working_backend
 
-    prefer_working_backend()
+        prefer_working_backend()
     return tools.REGISTRY[name](rest)
 
 

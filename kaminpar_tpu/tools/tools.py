@@ -223,8 +223,20 @@ def trace(argv) -> int:
     return 0
 
 
+def lint(argv) -> int:
+    """kptlint (ISSUE 7): AST-level enforcement of the device-discipline
+    contracts — sync budget, runtime isolation, phase registry, RNG and
+    donation safety — over the whole package.  Pure stdlib AST: no jax
+    import, so it runs in milliseconds and never wedges on a dead tunnel.
+    See kaminpar_tpu/analysis/ and the README "Static analysis" section."""
+    from ..analysis.cli import run_lint
+
+    return run_lint(argv)
+
+
 REGISTRY = {
     "graph-properties": graph_properties,
+    "lint": lint,
     "partition-properties": partition_properties,
     "connected-components": connected_components,
     "rearrange": rearrange,

@@ -2,7 +2,7 @@ from . import sync_stats
 from .assertions import assertion_level, kassert, kassert_heavy, set_assertion_level
 from .logger import Logger, OutputLevel, log_result_line
 from .platform import force_cpu_devices
-from .rng import RandomState, next_key, reseed
+from .rng import RandomState, next_key, reseed, seed_key
 from .timer import Timer, scoped_timer
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "RandomState",
     "next_key",
     "reseed",
+    "seed_key",
     "set_assertion_level",
     "sync_stats",
     "Timer",

@@ -74,6 +74,16 @@ def next_key():
 # ---------------------------------------------------------------------------
 
 
+def seed_key(seed: int):
+    """Root key of an explicit seed — the facade-sanctioned spelling of
+    ``jax.random.key(seed)`` for pipeline code that owns a seed *chain*
+    (the serve lane chains) rather than drawing from the thread-local
+    RandomState.  Bit-identical to the raw construction; exists so the
+    kptlint rng-discipline rule can tell sanctioned chain roots from stray
+    stream pins."""
+    return jax.random.key(int(seed))
+
+
 def lane_key(seed: int, lane):
     """Key of lane ``lane`` under graph seed ``seed`` (lane-count invariant).
 
