@@ -21,7 +21,7 @@ from ..core import Finding, LintConfig, Rule, SourceModule
 # Call leaf names whose first positional string argument is a phase name.
 _PHASE_ARG0_CALLS = {
     "scoped_timer", "scoped", "push_phase", "assert_phase_budget",
-    "phase_count", "lane_phase_count",
+    "phase_count", "lane_phase_count", "shard_phase_count",
 }
 # sync_stats helpers that attribute through a phase= keyword.
 _PHASE_KWARG_CALLS = {"pull", "record_transfer", "assert_phase_budget"}

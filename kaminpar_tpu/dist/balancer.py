@@ -33,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from functools import lru_cache
 
 from ..ops.bucketed_gains import flat_best_moves
-from .exchange import AXIS, ghost_exchange
+from .exchange import AXIS, ghost_exchange, pmax, psum
 from .lp import _neighbor_labels
 
 
@@ -52,7 +52,7 @@ def _balance_round_body(
     )
     cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
 
-    block_w = jax.lax.psum(
+    block_w = psum(
         jax.ops.segment_sum(
             node_w_loc, labels_loc.astype(jnp.int32), num_segments=k
         ),
@@ -83,7 +83,7 @@ def _balance_round_body(
 
     # Probabilistic source release: p_b = overload_b / global candidate
     # weight of b (candidates above the needed weight are thinned out).
-    cand_w = jax.lax.psum(
+    cand_w = psum(
         jax.ops.segment_sum(
             jnp.where(eligible, node_w_loc, 0),
             labels_loc.astype(jnp.int32),
@@ -100,7 +100,7 @@ def _balance_round_body(
     # Target-side probabilistic thinning: accept ∝ remaining capacity /
     # global demand, so receivers are not flooded past their cap before the
     # rollback fixpoint (which is all-or-nothing per block) runs.
-    demand = jax.lax.psum(
+    demand = psum(
         jax.ops.segment_sum(
             jnp.where(picked, node_w_loc, 0),
             target.astype(jnp.int32),
@@ -118,7 +118,7 @@ def _balance_round_body(
     # but blocks that were *already* overweight without arrivals are the
     # next round's problem, not a reason to spin.
     def overweight_fixable(kept):
-        w = jax.lax.psum(
+        w = psum(
             jax.ops.segment_sum(
                 node_w_loc,
                 jnp.where(kept, target, labels_loc).astype(jnp.int32),
@@ -126,7 +126,7 @@ def _balance_round_body(
             ),
             AXIS,
         )
-        arrivals = jax.lax.psum(
+        arrivals = psum(
             jax.ops.segment_sum(
                 kept.astype(jnp.int32),
                 target.astype(jnp.int32),
@@ -147,15 +147,18 @@ def _balance_round_body(
 
     kept, _ = jax.lax.while_loop(cond, body, (commit, overweight_fixable(commit)))
     new_labels = jnp.where(kept, target, labels_loc)
-    new_bw = jax.lax.psum(
+    new_bw = psum(
         jax.ops.segment_sum(
             node_w_loc, new_labels.astype(jnp.int32), num_segments=k
         ),
         AXIS,
     )
-    moved = jax.lax.psum(jnp.sum(kept).astype(jnp.int32), AXIS)
+    moved = psum(jnp.sum(kept).astype(jnp.int32), AXIS)
     still = jnp.any(new_bw > max_bw)
-    return new_labels, moved, still
+    # Packed (moved, still) round stats: the drive loop reads both in ONE
+    # counted mesh-wide pull per round (round 13; the shm balancer has
+    # packed its round stats since PR 2).
+    return new_labels, jnp.stack([moved, still.astype(jnp.int32)])
 
 
 def _cluster_balance_round_body(
@@ -184,7 +187,7 @@ def _cluster_balance_round_body(
     n_loc = labels_loc.shape[0]
     real = node_w_loc > 0
 
-    block_w = jax.lax.psum(
+    block_w = psum(
         jax.ops.segment_sum(
             node_w_loc, labels_loc.astype(jnp.int32), num_segments=k
         ),
@@ -271,14 +274,14 @@ def _cluster_balance_round_body(
 
     def _lex_best(mask, seg):
         segi = seg.astype(jnp.int32)
-        b1 = jax.lax.pmax(
+        b1 = pmax(
             jax.ops.segment_max(
                 jnp.where(mask, rel_bits, jnp.int32(-1)), segi, num_segments=k
             ),
             AXIS,
         )
         m2 = mask & (rel_bits == b1[segi])
-        b2 = jax.lax.pmax(
+        b2 = pmax(
             jax.ops.segment_max(
                 jnp.where(m2, gid, jnp.int32(-1)), segi, num_segments=k
             ),
@@ -295,13 +298,13 @@ def _cluster_balance_round_body(
     # -- receiver-side rollback fixpoint at cluster granularity -----------
     def overweight_fixable(kept):
         move_w = jnp.where(kept, cw, 0)
-        arrivals = jax.lax.psum(
+        arrivals = psum(
             jax.ops.segment_sum(
                 move_w, target.astype(jnp.int32), num_segments=k
             ),
             AXIS,
         )
-        w = block_w + arrivals - jax.lax.psum(
+        w = block_w + arrivals - psum(
             jax.ops.segment_sum(
                 move_w, cl_block.astype(jnp.int32), num_segments=k
             ),
@@ -323,15 +326,15 @@ def _cluster_balance_round_body(
     )
     move_cl = kept[clabels]
     new_labels = jnp.where(move_cl, target[clabels], labels_loc)
-    new_bw = jax.lax.psum(
+    new_bw = psum(
         jax.ops.segment_sum(
             node_w_loc, new_labels.astype(jnp.int32), num_segments=k
         ),
         AXIS,
     )
-    moved = jax.lax.psum(jnp.sum(move_cl & real).astype(jnp.int32), AXIS)
+    moved = psum(jnp.sum(move_cl & real).astype(jnp.int32), AXIS)
     still = jnp.any(new_bw > max_bw)
-    return new_labels, moved, still
+    return new_labels, jnp.stack([moved, still.astype(jnp.int32)])
 
 
 @lru_cache(maxsize=None)
@@ -341,7 +344,7 @@ def make_dist_cluster_balance_round(mesh: Mesh, *, k: int):
         mesh=mesh,
         in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
                   P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P()),
     )
     def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_bw,
                  send_idx, recv_map):
@@ -360,7 +363,7 @@ def make_dist_balance_round(mesh: Mesh, *, k: int):
         mesh=mesh,
         in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(),
                   P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P()),
     )
     def round_fn(key, labels, node_w, edge_u, col_loc, edge_w, max_bw,
                  send_idx, recv_map):
@@ -376,16 +379,21 @@ def dist_cluster_balance(mesh, key, labels, graph, max_bw, *, k: int,
                          max_rounds: int = 8):
     """Drive deterministic cluster-balance rounds (reference:
     cluster_balancer.cc).  Returns (labels, feasible)."""
+    from ..utils import sync_stats
+
     fn = make_dist_cluster_balance_round(mesh, k=k)
     for i in range(max_rounds):
-        labels, moved, still = fn(
+        labels, stats = fn(
             jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
             graph.col_loc, graph.edge_w, max_bw, graph.send_idx,
             graph.recv_map,
         )
-        if not bool(still):
+        # ONE counted mesh-wide readback per round: packed (moved, still)
+        # (round 13; was two implicit int()/bool() pulls).
+        stats_h = sync_stats.pull(stats, shards=graph.num_shards)
+        if not bool(stats_h[1]):
             return labels, True
-        if int(moved) == 0:
+        if int(stats_h[0]) == 0:
             break  # greedy and deterministic: a dry round stays dry
     return labels, False
 
@@ -398,22 +406,26 @@ def dist_balance(mesh, key, labels, graph, max_bw, *, k: int,
     move — the reference's escalation point), whole-cluster moves take
     over (``dist_cluster_balance``).  Returns (labels, feasible).
     ``max_bw`` is a (k,) block-weight cap."""
+    from ..utils import sync_stats
+
     fn = make_dist_balance_round(mesh, k=k)
     feasible = False
     dry = 0
     for i in range(max_rounds):
-        labels, moved, still = fn(
+        labels, stats = fn(
             jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
             graph.col_loc, graph.edge_w, max_bw, graph.send_idx,
             graph.recv_map,
         )
-        if not bool(still):
+        # ONE counted mesh-wide readback per round: packed (moved, still).
+        stats_h = sync_stats.pull(stats, shards=graph.num_shards)
+        if not bool(stats_h[1]):
             feasible = True
             break
         # A probabilistic round can legitimately move nothing once; only
         # consecutive dry rounds mean stuck (cluster-balancer territory in
         # the reference).
-        dry = dry + 1 if int(moved) == 0 else 0
+        dry = dry + 1 if int(stats_h[0]) == 0 else 0
         if dry >= 3:
             break
     if not feasible:

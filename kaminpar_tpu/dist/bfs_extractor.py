@@ -84,7 +84,9 @@ def dist_bfs_hops(mesh, dgraph, seeds: np.ndarray, *, radius: int) -> np.ndarray
     hops = fn(jnp.asarray(hop0), dgraph.edge_u.astype(jnp.int32),
               dgraph.col_loc.astype(jnp.int32), dgraph.send_idx,
               dgraph.recv_map)
-    return sync_stats.pull(hops, phase="dist_extract")[: dgraph.n]
+    return sync_stats.pull(
+        hops, phase="dist_extract", shards=dgraph.num_shards
+    )[: dgraph.n]
 
 
 def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
@@ -102,7 +104,8 @@ def dist_bfs_extract(mesh, dgraph, labels, seeds, *, radius: int, k: int,
     # One counted readback for the label/weight inputs of the host
     # extraction (round 12, kptlint sync-discipline).
     labels_host, node_w = sync_stats.pull(
-        labels, dgraph.node_w, phase="dist_extract"
+        labels, dgraph.node_w, phase="dist_extract",
+        shards=dgraph.num_shards,
     )
     labels_host = labels_host[: dgraph.n].astype(np.int64)
     # An out-of-range label would make the np.bincount below return more

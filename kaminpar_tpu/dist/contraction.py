@@ -30,7 +30,7 @@ Per level:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
@@ -43,11 +43,14 @@ from ..utils import sync_stats
 from ..utils.intmath import next_pow2
 from .exchange import (
     AXIS,
+    all_gather,
+    all_to_all,
     build_ghost_exchange,
     ghost_exchange,
     localize_columns,
     owner_aggregate,
     owner_query,
+    psum,
 )
 from .graph import DistGraph
 
@@ -76,14 +79,14 @@ def _s1(mesh, labels, node_w, *, n_loc: int, cap_q: int):
         cw_own, ovf = owner_aggregate(labels_loc, node_w_loc, ~real, n_loc, cap_q)
         used = cw_own > 0
         cnt = jnp.sum(used).astype(jnp.int32)
-        cnts = jax.lax.all_gather(cnt, AXIS)  # (P,) — O(P), not O(N)
+        cnts = all_gather(cnt, AXIS)  # (P,) — O(P), not O(N)
         idx = jax.lax.axis_index(AXIS)
         base = (jnp.cumsum(cnts) - cnts)[idx].astype(labels_loc.dtype)
         cmap_own = jnp.where(
             used, base + jnp.cumsum(used.astype(labels_loc.dtype)) - 1, -1
         )
-        n_c = jax.lax.psum(cnt, AXIS)  # psum → statically replicated
-        return n_c, cw_own, cmap_own, jax.lax.psum(ovf, AXIS)
+        n_c = psum(cnt, AXIS)  # psum → statically replicated
+        return n_c, cw_own, cmap_own, psum(ovf, AXIS)
 
     return body(labels, node_w)
 
@@ -141,7 +144,7 @@ def _s2(mesh, labels, cmap_own, cw_own, edge_u, col_loc, edge_w, send_idx,
             cu_node,
             cu[order], cv[order], jnp.where(keep, ew, 0)[order], counts,
             cmap_own_loc[worder], cw_own_loc[worder], wcounts,
-            jax.lax.psum(ovf, AXIS),
+            psum(ovf, AXIS),
         )
 
     return body(labels, cmap_own, cw_own, edge_u, col_loc, edge_w,
@@ -179,9 +182,9 @@ def _s3(mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts, *,
         send_cu = _pack(cu, cnt, cap, jnp.asarray(0, cu.dtype))
         send_cv = _pack(cv, cnt, cap, jnp.asarray(0, cv.dtype))
         send_w = _pack(w, cnt, cap, jnp.asarray(0, w.dtype))
-        r_cu = jax.lax.all_to_all(send_cu, AXIS, 0, 0).reshape(-1)
-        r_cv = jax.lax.all_to_all(send_cv, AXIS, 0, 0).reshape(-1)
-        r_w = jax.lax.all_to_all(send_w, AXIS, 0, 0).reshape(-1)
+        r_cu = all_to_all(send_cu, AXIS, 0, 0).reshape(-1)
+        r_cv = all_to_all(send_cv, AXIS, 0, 0).reshape(-1)
+        r_w = all_to_all(send_w, AXIS, 0, 0).reshape(-1)
 
         # local aggregation by (cu_local, cv)
         S = r_cu.shape[0]  # P_ * cap
@@ -204,8 +207,8 @@ def _s3(mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts, *,
         # coarse node weights: aggregate received (compact id, weight) pairs
         send_wk = _pack(wk, wcnt, cap_w, jnp.asarray(-1, wk.dtype))
         send_wv = _pack(wv, wcnt, cap_w, jnp.asarray(0, wv.dtype))
-        r_wk = jax.lax.all_to_all(send_wk, AXIS, 0, 0).reshape(-1)
-        r_wv = jax.lax.all_to_all(send_wv, AXIS, 0, 0).reshape(-1)
+        r_wk = all_to_all(send_wk, AXIS, 0, 0).reshape(-1)
+        r_wv = all_to_all(send_wv, AXIS, 0, 0).reshape(-1)
         wl = r_wk - idx.astype(r_wk.dtype) * n_loc_c
         wok = (wl >= 0) & (wl < n_loc_c)
         node_w_c = jax.ops.segment_sum(
@@ -248,10 +251,14 @@ def contract_dist_clustering(
         n_c, cw_own, cmap_own, ovf = _s1(
             mesh, labels, graph.node_w, n_loc=n_loc, cap_q=cap_q
         )
-        if int(ovf) == 0 or cap_q >= n_loc:
+        # Packed (n_c, overflow) readback: both mesh-replicated scalars
+        # leave the device in ONE counted transfer per attempt (round 13:
+        # the int() coercions here were un-counted implicit pulls).
+        s1_stats = sync_stats.pull(jnp.stack([n_c, ovf]), shards=Pn)
+        if int(s1_stats[1]) == 0 or cap_q >= n_loc:
             break
         cap_q = min(cap_q * 2, n_loc)
-    n_c = int(n_c)
+    n_c = int(s1_stats[0])
     n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
 
     cap_q2 = cap_q
@@ -261,13 +268,14 @@ def contract_dist_clustering(
             graph.edge_w, graph.send_idx, graph.recv_map,
             n_loc=n_loc, n_loc_c=n_loc_c, cap_q=cap_q2,
         )
-        if int(ovf2) == 0 or cap_q2 >= n_loc + graph.g_loc:
+        ovf2_h = int(sync_stats.pull(ovf2, shards=Pn))
+        if ovf2_h == 0 or cap_q2 >= n_loc + graph.g_loc:
             break
         cap_q2 = min(cap_q2 * 2, n_loc + graph.g_loc)
 
     # Counted batched readback of the staging counts (round 12, kptlint
     # sync-discipline: these were un-counted np.asarray strays).
-    counts_h, wcounts_h = sync_stats.pull(counts, wcounts)
+    counts_h, wcounts_h = sync_stats.pull(counts, wcounts, shards=Pn)
     cap = next_pow2(int(counts_h.max()), 8)
     cap_w = next_pow2(int(wcounts_h.max()), 8)
 
@@ -275,7 +283,7 @@ def contract_dist_clustering(
         mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts,
         num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
     )
-    m_c_loc = sync_stats.pull(m_c_loc)
+    m_c_loc = sync_stats.pull(m_c_loc, shards=Pn)
     m_loc_c = next_pow2(int(m_c_loc.max()), 8)
     m_loc_c = min(m_loc_c, Pn * cap)  # aggregation buffer bound (ADVICE r1)
 
@@ -299,7 +307,7 @@ def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c,
     Pn = num_shards
     m_total = int(m_c_loc.sum())  # pulled by the caller alongside the caps
     # One counted batched readback for the host assembly inputs.
-    eu_l, cv_g, w_np = sync_stats.pull(edge_u_g, col_g, edge_w_c)
+    eu_l, cv_g, w_np = sync_stats.pull(edge_u_g, col_g, edge_w_c, shards=Pn)
     eu_l = eu_l.reshape(Pn, m_loc_c)
     cv_g = cv_g.reshape(Pn, m_loc_c)
     w_np = w_np.reshape(Pn, m_loc_c)
@@ -320,6 +328,21 @@ def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c,
         ]
     )
 
+    # Per-shard work table from the SAME host arrays the assembly already
+    # holds (round 13): the coarse level's mesh-telemetry lanes and
+    # ShardStats cost zero extra readbacks.
+    from .graph import compute_shard_work
+
+    shard_work = compute_shard_work(
+        send_idx, ghost_global,
+        owned_nodes=[
+            max(0, min((s + 1) * n_loc_c, int(n_c)) - s * n_loc_c)
+            for s in range(Pn)
+        ],
+        owned_edges=[int((w_np[s] > 0).sum()) for s in range(Pn)],
+        n_loc=n_loc_c, num_shards=Pn,
+    )
+
     return DistGraph(
         node_w=jnp.asarray(node_w_c).reshape(-1),
         edge_u=jnp.asarray(edge_u_c.reshape(-1)),
@@ -335,7 +358,31 @@ def _assemble_coarse(edge_u_g, col_g, edge_w_c, node_w_c,
         g_loc=g_loc,
         cap_g=cap_g,
         num_shards=Pn,
+        shard_work=shard_work,
     )
+
+
+@lru_cache(maxsize=None)
+def _make_project_up(mesh: Mesh, *, n_loc_c: int, cap: int):
+    """Cached projection program: the old inline ``jax.jit`` closure
+    re-traced (and re-counted its collectives) on EVERY uncoarsening level
+    of every run — caching on (mesh, n_loc_c, cap) matches the other
+    make_dist_* factories (found via the round-13 collective census, which
+    showed a constant per-run trace delta on identical repeat runs)."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)), out_specs=(P(AXIS), P()),
+    )
+    def body(c_of_loc, c_part_loc):
+        drop = c_of_loc < 0
+        vals, ovf = owner_query(
+            c_of_loc, drop, c_part_loc, n_loc_c, cap,
+            fill=jnp.asarray(0, c_part_loc.dtype),
+        )
+        return jnp.where(drop, 0, vals), psum(ovf, AXIS)
+
+    return jax.jit(body)
 
 
 def project_partition_up(mesh, coarse_of, coarse_part, *, n_loc_c: int,
@@ -348,25 +395,12 @@ def project_partition_up(mesh, coarse_of, coarse_part, *, n_loc_c: int,
     if cap_q is None:
         cap_q = min(next_pow2(max(64, 2 * n_loc_f // mesh.size), 8), n_loc_f)
 
-    @partial(jax.jit, static_argnames=("cap",))
-    def run(c_of, c_part, *, cap):
-        @partial(
-            jax.shard_map, mesh=mesh,
-            in_specs=(P(AXIS), P(AXIS)), out_specs=(P(AXIS), P()),
-        )
-        def body(c_of_loc, c_part_loc):
-            drop = c_of_loc < 0
-            vals, ovf = owner_query(
-                c_of_loc, drop, c_part_loc, n_loc_c, cap,
-                fill=jnp.asarray(0, c_part_loc.dtype),
-            )
-            return jnp.where(drop, 0, vals), jax.lax.psum(ovf, AXIS)
-
-        return body(c_of, c_part)
-
     while True:
-        out, ovf = run(coarse_of, coarse_part, cap=cap_q)
-        if int(ovf) == 0 or cap_q >= n_loc_f:
+        out, ovf = _make_project_up(mesh, n_loc_c=n_loc_c, cap=cap_q)(
+            coarse_of, coarse_part
+        )
+        # Counted overflow readback (round 13; was an implicit int() pull).
+        if int(sync_stats.pull(ovf, shards=mesh.size)) == 0 or cap_q >= n_loc_f:
             break
         cap_q = min(cap_q * 2, n_loc_f)
     return out
@@ -405,7 +439,7 @@ def _l1(mesh, labels, node_w, *, n_loc: int, n_real: int):
         base = idx.astype(labels_loc.dtype) * n_loc
         real = base + jnp.arange(n_loc, dtype=labels_loc.dtype) < n_real
         lab_l = labels_loc - base
-        nonlocal_count = jax.lax.psum(
+        nonlocal_count = psum(
             jnp.sum(real & ((lab_l < 0) | (lab_l >= n_loc))).astype(jnp.int32),
             AXIS,
         )
@@ -521,12 +555,13 @@ def contract_local_clustering(
     cw, rank, counts, nonlocal_count = _l1(
         mesh, labels, graph.node_w, n_loc=n_loc, n_real=graph.n
     )
-    if int(nonlocal_count) > 0:
+    nonlocal_h = int(sync_stats.pull(nonlocal_count, shards=Pn))
+    if nonlocal_h > 0:
         raise ValueError(
-            f"{int(nonlocal_count)} nodes have non-local cluster ids; use "
+            f"{nonlocal_h} nodes have non-local cluster ids; use "
             "contract_dist_clustering for clusterings that span shards"
         )
-    counts = sync_stats.pull(counts)
+    counts = sync_stats.pull(counts, shards=Pn)
     n_c = int(counts.sum())
     n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
     r_loc = next_pow2(int(counts.max()), 8)
@@ -537,7 +572,7 @@ def contract_local_clustering(
         graph.edge_w, graph.send_idx, graph.recv_map,
         n_loc=n_loc, n_loc_c=n_loc_c, r_loc=r_loc, n_real=graph.n,
     )
-    ecounts_h, wcounts_h = sync_stats.pull(ecounts, wcounts)
+    ecounts_h, wcounts_h = sync_stats.pull(ecounts, wcounts, shards=Pn)
     cap = next_pow2(int(ecounts_h.max()), 8)
     cap_w = next_pow2(int(wcounts_h.max()), 8)
 
@@ -545,7 +580,7 @@ def contract_local_clustering(
         mesh, s_cu, s_cv, s_w, ecounts, w_keys, w_vals, wcounts,
         num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
     )
-    m_c_loc = sync_stats.pull(m_c_loc)
+    m_c_loc = sync_stats.pull(m_c_loc, shards=Pn)
     m_loc_c = next_pow2(int(m_c_loc.max()), 8)
     m_loc_c = min(m_loc_c, Pn * cap)
     edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)

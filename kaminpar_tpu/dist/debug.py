@@ -54,7 +54,10 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
     problems = []
     # One counted readback for the label + weight sweep (round 12, kptlint
     # sync-discipline: these were un-counted np.asarray transfers).
-    lab, node_w = sync_stats.pull(labels, graph.node_w, phase="dist_validation")
+    lab, node_w = sync_stats.pull(
+        labels, graph.node_w, phase="dist_validation",
+        shards=graph.num_shards,
+    )
     real = node_w > 0
 
     if real.any():
@@ -67,7 +70,7 @@ def validate_partition(mesh: Mesh, labels, graph, k: int, max_block_weights=None
     # ghost consistency through the actual exchange program
     gl = sync_stats.pull(
         _make_ghost_reader(mesh)(labels, graph.send_idx, graph.recv_map),
-        phase="dist_validation",
+        phase="dist_validation", shards=graph.num_shards,
     )
     gl = gl.reshape(graph.num_shards, graph.g_loc)
     for s in range(graph.num_shards):

@@ -34,9 +34,54 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import collective_stats
 from ..utils.intmath import next_pow2
 
 AXIS = "nodes"
+
+
+# ---------------------------------------------------------------------------
+# Counted collective wrappers (round 13).  The reference's communication
+# layer (kaminpar-mpi/sparse_alltoall.h, grid_alltoall.h) counts messages
+# and bytes per call; the TPU analog counts at TRACE time — Python inside a
+# jitted body runs once per compiled specialization — so the census adds
+# zero collectives, zero readbacks, and zero per-execution work (semantics
+# in utils/collective_stats.py + TPU_NOTES.md round 13).  Every dist-tier
+# collective routes through these instead of jax.lax directly.
+# ---------------------------------------------------------------------------
+
+
+def _count(op: str, x, axis_name: str) -> None:
+    collective_stats.record(
+        op,
+        collective_stats.traced_bytes(jnp.shape(x), jnp.result_type(x)),
+        jax.lax.axis_size(axis_name),
+    )
+
+
+def psum(x, axis_name: str = AXIS):
+    """Counted ``jax.lax.psum`` (single-array operands only)."""
+    _count("psum", x, axis_name)
+    return jax.lax.psum(x, axis_name)
+
+
+def pmax(x, axis_name: str = AXIS):
+    """Counted ``jax.lax.pmax``."""
+    _count("pmax", x, axis_name)
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_to_all(x, axis_name: str = AXIS, split_axis: int = 0,
+               concat_axis: int = 0):
+    """Counted ``jax.lax.all_to_all``."""
+    _count("all_to_all", x, axis_name)
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis)
+
+
+def all_gather(x, axis_name: str = AXIS, **kwargs):
+    """Counted ``jax.lax.all_gather``."""
+    _count("all_gather", x, axis_name)
+    return jax.lax.all_gather(x, axis_name, **kwargs)
 
 
 class GhostExchange(NamedTuple):
@@ -149,7 +194,7 @@ def ghost_exchange(vals_loc, send_idx, recv_map, *, fill):
     """
     ext = jnp.concatenate([vals_loc, jnp.full((1,), fill, vals_loc.dtype)])
     send = ext[send_idx]  # (P, cap_g); pads read the fill slot
-    recv = jax.lax.all_to_all(send, AXIS, 0, 0)  # (P, cap_g)
+    recv = all_to_all(send, AXIS, 0, 0)  # (P, cap_g)
     recv_ext = jnp.concatenate(
         [recv.reshape(-1), jnp.full((1,), fill, vals_loc.dtype)]
     )
@@ -202,13 +247,13 @@ def owner_query(keys, drop, table_loc, n_loc: int, cap: int, *, fill):
     P = jax.lax.axis_size(AXIS)
     base = jax.lax.axis_index(AXIS).astype(keys.dtype) * n_loc
     key_buf, _, flat_pos, overflow = pack_by_owner(keys, drop, n_loc, cap)
-    recv = jax.lax.all_to_all(key_buf, AXIS, 0, 0)  # (P, cap) keys to serve
+    recv = all_to_all(key_buf, AXIS, 0, 0)  # (P, cap) keys to serve
     local = recv.reshape(-1) - base
     ok = (local >= 0) & (local < n_loc)
     resp = jnp.where(
         ok, table_loc[jnp.clip(local, 0, n_loc - 1)], jnp.asarray(fill, table_loc.dtype)
     ).reshape(P, cap)
-    back = jax.lax.all_to_all(resp, AXIS, 0, 0)  # (P, cap) answers
+    back = all_to_all(resp, AXIS, 0, 0)  # (P, cap) answers
     back_ext = jnp.concatenate(
         [back.reshape(-1), jnp.full((1,), fill, table_loc.dtype)]
     )
@@ -241,8 +286,8 @@ def owner_aggregate(keys, vals, drop, n_loc: int, cap: int):
     key_buf, (val_buf,), _, overflow = pack_by_owner(
         k_sorted, send_drop, n_loc, cap, jnp.where(send_drop, 0, run_sum)
     )
-    rk = jax.lax.all_to_all(key_buf, AXIS, 0, 0).reshape(-1)
-    rv = jax.lax.all_to_all(val_buf, AXIS, 0, 0).reshape(-1)
+    rk = all_to_all(key_buf, AXIS, 0, 0).reshape(-1)
+    rv = all_to_all(val_buf, AXIS, 0, 0).reshape(-1)
     local = rk - base
     ok = (local >= 0) & (local < n_loc)
     return (

@@ -95,7 +95,8 @@ def _comm_down(mesh, coarse_of, comm, node_w, *, n_loc_c: int, n_loc: int,
         comm_c, ovf = make_comm_down(mesh, n_loc_c=n_loc_c, cap_q=cap_q)(
             coarse_of, comm, node_w
         )
-        if int(ovf) == 0 or cap_q >= n_loc:
+        # Counted overflow readback (round 13; was an implicit int() pull).
+        if int(sync_stats.pull(ovf, shards=num_shards)) == 0 or cap_q >= n_loc:
             return comm_c
         cap_q = min(cap_q * 2, n_loc)
 
@@ -122,7 +123,9 @@ def dist_extend_partition(mesh, part_dev, dgraph, cur_k: int, target_k: int,
                          cur.send_idx, cur.recv_map)
         mg = cur._replace(edge_w=masked)
         if total_w is None:
-            total_w = int(sync_stats.pull(jnp.sum(cur.node_w)))
+            total_w = int(
+                sync_stats.pull(jnp.sum(cur.node_w), shards=cur.num_shards)
+            )
         max_cw = max(
             int(eps * total_w / max(min(cur.n // max(C, 1), target_k), 2)), 1
         )

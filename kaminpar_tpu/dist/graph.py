@@ -64,6 +64,13 @@ class DistGraph(NamedTuple):
     g_loc: int
     cap_g: int
     num_shards: int
+    #: Per-shard static work table (round 13): tuple of P dicts with
+    #: owned_nodes / owned_edges / ghost_nodes / interface_nodes, computed
+    #: HOST-SIDE at build time (distribute_graph / _assemble_coarse already
+    #: hold every input as numpy) — so the mesh telemetry's shard lanes and
+    #: ShardStats cost ZERO device readbacks.  Empty tuple when a build
+    #: path does not populate it (consumers fall back or skip).
+    shard_work: tuple = ()
 
     @property
     def N(self) -> int:
@@ -85,7 +92,8 @@ class DistGraph(NamedTuple):
         # One counted readback for the full-edge gather (round 12, kptlint
         # sync-discipline): the replicate/BFS paths pay this knowingly.
         eu, cl, ew = sync_stats.pull(
-            self.edge_u, self.col_loc, self.edge_w, phase="dist_extract"
+            self.edge_u, self.col_loc, self.edge_w, phase="dist_extract",
+            shards=self.num_shards,
         )
         eu = eu.reshape(self.num_shards, self.m_loc)
         cl = cl.reshape(self.num_shards, self.m_loc)
@@ -138,6 +146,33 @@ class DistGraph(NamedTuple):
             self.g_loc,
             self.num_shards * self.cap_g,  # exchange buffers / routing
         )
+
+
+def compute_shard_work(
+    send_idx: np.ndarray,
+    ghost_global,
+    owned_nodes,
+    owned_edges,
+    n_loc: int,
+    num_shards: int,
+) -> tuple:
+    """Host-side per-shard work table (round 13) from build-time arrays:
+    the quantities per-rank wall time proxies in the reference's dist timer
+    rows (see dist/shard_stats.py for the SPMD argument).  ``send_idx`` is
+    the HOST routing array (rows t*P+s hold the local slots shard t sends
+    shard s; pads hold n_loc)."""
+    P = num_shards
+    rows = send_idx.reshape(P, P, -1)
+    work = []
+    for s in range(P):
+        sent = rows[s][rows[s] < n_loc]
+        work.append({
+            "owned_nodes": int(owned_nodes[s]),
+            "owned_edges": int(owned_edges[s]),
+            "ghost_nodes": int(len(ghost_global[s])),
+            "interface_nodes": int(len(np.unique(sent))),
+        })
+    return tuple(work)
 
 
 def distribute_graph(
@@ -211,6 +246,17 @@ def distribute_graph(
         ]
     )
 
+    shard_work = compute_shard_work(
+        send_idx, ghost_global,
+        owned_nodes=[
+            max(0, min((s + 1) * n_loc, n) - s * n_loc) for s in range(P)
+        ],
+        owned_edges=[
+            int((edge_w[s * m_loc:(s + 1) * m_loc] > 0).sum()) for s in range(P)
+        ],
+        n_loc=n_loc, num_shards=P,
+    )
+
     jnp = jax.numpy
     return DistGraph(
         node_w=jnp.asarray(node_w),
@@ -227,4 +273,5 @@ def distribute_graph(
         g_loc=g_loc,
         cap_g=cap_g,
         num_shards=P,
+        shard_work=shard_work,
     )

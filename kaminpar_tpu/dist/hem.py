@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .exchange import AXIS, ghost_exchange
+from .exchange import AXIS, ghost_exchange, psum
 from .lp import _neighbor_labels
 
 _I32MAX = jnp.iinfo(jnp.int32).max
@@ -84,7 +84,7 @@ def _hem_round_body(key, match_loc, node_w, edge_u, col_loc, edge_w, max_cw,
     )
     hit = (partner >= 0) & unmatched
     new_match = jnp.where(hit, partner.astype(match_loc.dtype), match_loc)
-    num_matched = jax.lax.psum(jnp.sum(hit).astype(jnp.int32), AXIS)
+    num_matched = psum(jnp.sum(hit).astype(jnp.int32), AXIS)
     return new_match, num_matched
 
 
@@ -118,6 +118,8 @@ def dist_hem_cluster(mesh, key, graph, max_cw, *, num_rounds: int = 5):
     from .lp import shard_arrays
 
     match, graph = shard_arrays(mesh, graph, match)
+    from ..utils import sync_stats
+
     total = jnp.int32(0)
     for i in range(num_rounds):
         match, matched = fn(
@@ -125,10 +127,11 @@ def dist_hem_cluster(mesh, key, graph, max_cw, *, num_rounds: int = 5):
             graph.col_loc, graph.edge_w, jnp.asarray(max_cw, graph.dtype),
             graph.send_idx, graph.recv_map,
         )
-        if int(matched) == 0:
+        # Counted per-round convergence readback (round 13).
+        if int(sync_stats.pull(matched, shards=graph.num_shards)) == 0:
             break
         total = total + matched
     labels = jnp.minimum(match, jnp.arange(N, dtype=graph.dtype))
-    from ..utils import sync_stats
-
-    return labels, int(sync_stats.pull(total)) // 2
+    return labels, int(
+        sync_stats.pull(total, shards=graph.num_shards)
+    ) // 2

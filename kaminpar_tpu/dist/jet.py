@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.bucketed_gains import flat_best_moves, lookup
 from .balancer import dist_balance
-from .exchange import AXIS, ghost_exchange
+from .exchange import AXIS, ghost_exchange, psum
 from .lp import _neighbor_labels
 from .metrics import dist_edge_cut
 
@@ -40,7 +40,7 @@ def _jet_round_body(
     )
     cand = _neighbor_labels(labels_loc, ghost_labels, col_loc, 0)
 
-    cluster_w = jax.lax.psum(
+    cluster_w = psum(
         jax.ops.segment_sum(
             node_w_loc, labels_loc.astype(jnp.int32), num_segments=num_labels
         ),

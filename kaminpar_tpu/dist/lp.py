@@ -38,13 +38,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.bucketed_gains import flat_best_moves, lookup
 from ..utils.intmath import next_pow2
-from .exchange import AXIS, ghost_exchange, owner_aggregate, pack_by_owner
+from .exchange import (
+    AXIS,
+    all_to_all,
+    ghost_exchange,
+    owner_aggregate,
+    pack_by_owner,
+    pmax,
+    psum,
+)
 
 
 def _global_block_weights(node_w_loc, labels_loc, num_labels: int):
     """psum'd (num_labels,) block-weight table — the replicated table every
     refinement round keeps (distributed_partitioned_graph.h:15)."""
-    return jax.lax.psum(
+    return psum(
         jax.ops.segment_sum(
             node_w_loc, labels_loc.astype(jnp.int32), num_segments=num_labels
         ),
@@ -75,7 +83,7 @@ def _probabilistic_commit(
     (shared by the plain and colored refinement rounds; see
     _refine_round_body for the semantics).  ``cluster_w`` is the callers'
     already-reduced global block-weight table."""
-    demand = jax.lax.psum(
+    demand = psum(
         jax.ops.segment_sum(
             jnp.where(mover, node_w_loc, 0),
             desired.astype(jnp.int32),
@@ -102,7 +110,7 @@ def _overweight_rollback(commit, desired, labels_loc, node_w_loc, max_w,
         w = _global_block_weights(
             node_w_loc, jnp.where(kept, desired, labels_loc), num_labels
         )
-        arrivals = jax.lax.psum(
+        arrivals = psum(
             jax.ops.segment_sum(
                 kept.astype(jnp.int32),
                 desired.astype(jnp.int32),
@@ -123,7 +131,7 @@ def _overweight_rollback(commit, desired, labels_loc, node_w_loc, max_w,
 
     kept, _ = jax.lax.while_loop(cond, body, (commit, overweight_fixable(commit)))
     final_labels = jnp.where(kept, desired, labels_loc)
-    num_moved = jax.lax.psum(jnp.sum(kept).astype(jnp.int32), AXIS)
+    num_moved = psum(jnp.sum(kept).astype(jnp.int32), AXIS)
     return final_labels, num_moved
 
 
@@ -271,9 +279,9 @@ def _cluster_round_body(
         desired, ~mover, n_loc, cap_q,
         jnp.where(mover, node_w_loc, 0), jnp.where(mover, gain, 0),
     )
-    rk = jax.lax.all_to_all(key_buf, AXIS, 0, 0).reshape(-1)
-    rw = jax.lax.all_to_all(w_buf, AXIS, 0, 0).reshape(-1)
-    rg = jax.lax.all_to_all(g_buf, AXIS, 0, 0).reshape(-1)
+    rk = all_to_all(key_buf, AXIS, 0, 0).reshape(-1)
+    rw = all_to_all(w_buf, AXIS, 0, 0).reshape(-1)
+    rg = all_to_all(g_buf, AXIS, 0, 0).reshape(-1)
     S = rk.shape[0]  # nshards * cap_q
 
     local = rk - base
@@ -291,13 +299,13 @@ def _cluster_round_body(
     ]
     accept_sorted = (ls < n_loc) & (ws > 0) & (cum_incl <= remaining)
     accept_flat = jnp.zeros(S, bool).at[slot].set(accept_sorted)
-    back = jax.lax.all_to_all(accept_flat.reshape(nshards, cap_q), AXIS, 0, 0)
+    back = all_to_all(accept_flat.reshape(nshards, cap_q), AXIS, 0, 0)
     back_ext = jnp.concatenate([back.reshape(-1), jnp.zeros(1, bool)])
     accepted = mover & back_ext[flat_pos]
 
     final_labels = jnp.where(accepted, desired, labels_loc)
-    num_moved = jax.lax.psum(jnp.sum(accepted).astype(jnp.int32), AXIS)
-    overflow = jax.lax.psum(ovf_w + ovf_a, AXIS)
+    num_moved = psum(jnp.sum(accepted).astype(jnp.int32), AXIS)
+    overflow = psum(ovf_w + ovf_a, AXIS)
     return final_labels, num_moved, overflow
 
 
@@ -336,6 +344,8 @@ def dist_cluster_iterate(mesh, key, labels, graph, max_w, *, num_rounds: int,
         cap_q = min(
             next_pow2(max(64, 2 * n_loc // max(graph.num_shards, 1)), 8), n_loc
         )
+    from ..utils import sync_stats
+
     fn = make_dist_cluster_round(mesh, cap_q=cap_q)
     total = jnp.int32(0)
     for i in range(num_rounds):
@@ -345,7 +355,10 @@ def dist_cluster_iterate(mesh, key, labels, graph, max_w, *, num_rounds: int,
                 graph.col_loc, graph.edge_w, max_w, graph.send_idx,
                 graph.recv_map,
             )
-            if int(ovf) == 0 or cap_q >= n_loc:
+            # Counted mesh-wide overflow readback, one per attempt
+            # (round 13; was an implicit int() pull).
+            ovf_h = int(sync_stats.pull(ovf, shards=graph.num_shards))
+            if ovf_h == 0 or cap_q >= n_loc:
                 break
             cap_q = min(cap_q * 2, n_loc)
             fn = make_dist_cluster_round(mesh, cap_q=cap_q)
@@ -395,7 +408,7 @@ def _local_cluster_round_body(
         num_labels=n_loc,
     )
     final_labels = jnp.where(mover & accept, desired, labels_loc)
-    num_moved = jax.lax.psum(jnp.sum(mover & accept).astype(jnp.int32), AXIS)
+    num_moved = psum(jnp.sum(mover & accept).astype(jnp.int32), AXIS)
     return final_labels, num_moved
 
 
@@ -426,6 +439,8 @@ def dist_local_cluster_iterate(mesh, key, labels, graph, max_w, *,
     the global clusterer at the cost of never merging across shard
     boundaries (the reference pairs it with global LP on alternating levels
     for the same reason)."""
+    from ..utils import sync_stats
+
     fn = make_dist_local_cluster_round(mesh)
     total = jnp.int32(0)
     for i in range(num_rounds):
@@ -433,7 +448,8 @@ def dist_local_cluster_iterate(mesh, key, labels, graph, max_w, *,
             jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
             graph.col_loc, graph.edge_w, max_w,
         )
-        if int(moved) == 0:
+        # Counted per-round convergence readback (round 13).
+        if int(sync_stats.pull(moved, shards=graph.num_shards)) == 0:
             break
         total = total + moved
     return labels, total
@@ -529,7 +545,7 @@ def make_dist_coloring(mesh: Mesh, *, max_rounds: int = 96):
 
         def cond(carry):
             i, colors = carry
-            any_left = jax.lax.psum(
+            any_left = psum(
                 jnp.sum((colors < 0).astype(jnp.int32)), AXIS
             )
             return (i < max_rounds) & (any_left > 0)
@@ -571,7 +587,9 @@ def dist_color(mesh: Mesh, graph, *, return_forced: bool = False):
     if return_forced:
         from ..utils import sync_stats
 
-        return colors, int(sync_stats.pull((raw < 0).sum()))
+        return colors, int(
+            sync_stats.pull((raw < 0).sum(), shards=graph.num_shards)
+        )
     return colors
 
 
@@ -641,7 +659,8 @@ def dist_clp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
     colors, forced = dist_color(mesh, graph, return_forced=True)
     from ..utils import sync_stats
 
-    nc = int(sync_stats.pull(jnp.max(colors))) + 1
+    Pn = graph.num_shards
+    nc = int(sync_stats.pull(jnp.max(colors), shards=Pn)) + 1
     if forced > 0:
         # Round cap left stragglers at color 0: the coloring may be
         # improper, so color classes are no longer independent sets and
@@ -669,10 +688,16 @@ def dist_clp_iterate(mesh, key, labels, graph, max_w, *, num_labels: int,
                 graph.edge_w, max_w, graph.send_idx, graph.recv_map,
             )
             if sync_each:
-                moved_parts.append(int(moved))
+                # Counted per-superstep fence (round 13; was implicit int()).
+                moved_parts.append(int(sync_stats.pull(moved, shards=Pn)))
             else:
                 moved_parts.append(moved)
-        moved_iter = int(sum(moved_parts))
+        if sync_each:
+            moved_iter = sum(moved_parts)
+        else:
+            # ONE counted readback per iteration for the whole superstep
+            # cycle (the non-CPU path's single fence).
+            moved_iter = int(sync_stats.pull(sum(moved_parts), shards=Pn))
         total += moved_iter
         if moved_iter == 0:
             break
@@ -703,7 +728,7 @@ def _best_moves_commit(
     # scanned from the best bucket down).
     # movers all have gain >= 1 (desired only diverges on positive gain),
     # so the bucket span is simply [0, gmax]
-    gmax = jnp.maximum(jax.lax.pmax(jnp.max(jnp.where(mover, gain, -(2**30))), AXIS), 1)
+    gmax = jnp.maximum(pmax(jnp.max(jnp.where(mover, gain, -(2**30))), AXIS), 1)
     # float32 bucket arithmetic: (gmax - gain) * 31 wraps int32 once the max
     # gain exceeds ~2^31/31 (reachable with large edge weights), which would
     # classify the *worst* movers as best (ADVICE r2).  The quantization is
@@ -714,7 +739,7 @@ def _best_moves_commit(
     )
 
     flat = desired.astype(jnp.int32) * _GAIN_BUCKETS + bucket
-    hist = jax.lax.psum(
+    hist = psum(
         jax.ops.segment_sum(
             jnp.where(mover, node_w_loc, 0), flat,
             num_segments=num_labels * _GAIN_BUCKETS,
@@ -779,7 +804,7 @@ def _best_refine_round_body(
     mover = desired != labels_loc
     admit_w = cluster_w
     if eager:
-        leaving = jax.lax.psum(
+        leaving = psum(
             jax.ops.segment_sum(
                 jnp.where(mover, node_w_loc, 0),
                 labels_loc.astype(jnp.int32),

@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from .exchange import ghost_exchange
+from .exchange import ghost_exchange, psum
 from .lp import _neighbor_labels
 
 AXIS = "nodes"
@@ -49,8 +49,8 @@ def make_dist_metrics(mesh: Mesh, *, k: int):
         # undirected edge is stored twice (once per endpoint), so the
         # psum double-counts and we halve outside.
         local_cut = jnp.sum(jnp.where(own != nbr, edge_w, 0))
-        cut2 = jax.lax.psum(local_cut, AXIS)
-        bw = jax.lax.psum(
+        cut2 = psum(local_cut, AXIS)
+        bw = psum(
             jax.ops.segment_sum(node_w, labels.astype(jnp.int32), num_segments=k),
             AXIS,
         )
@@ -70,7 +70,9 @@ def dist_edge_cut(mesh: Mesh, labels, graph, *, k: int) -> int:
         graph.send_idx, graph.recv_map,
     )
     # int(cut2) was an un-counted implicit scalar pull (round 12).
-    return int(sync_stats.pull(cut2, phase="dist_metrics")) // 2
+    return int(
+        sync_stats.pull(cut2, phase="dist_metrics", shards=graph.num_shards)
+    ) // 2
 
 
 def dist_block_weights(mesh: Mesh, labels, graph, *, k: int) -> np.ndarray:
@@ -82,7 +84,7 @@ def dist_block_weights(mesh: Mesh, labels, graph, *, k: int) -> np.ndarray:
     )
     # Counted readback (round 12): the (k,) weight table leaves the device
     # exactly once per metrics call.
-    return sync_stats.pull(bw, phase="dist_metrics")
+    return sync_stats.pull(bw, phase="dist_metrics", shards=graph.num_shards)
 
 
 def dist_imbalance(mesh: Mesh, labels, graph, *, k: int) -> float:

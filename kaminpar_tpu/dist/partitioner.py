@@ -25,6 +25,8 @@ from jax.sharding import Mesh
 from ..context import Context
 from ..graph.csr import CSRGraph, from_edge_list
 from ..graph import metrics
+from ..telemetry import probes
+from ..telemetry import trace as ttrace
 from ..utils import RandomState, sync_stats
 from ..utils.logger import Logger, OutputLevel
 from ..utils.timer import scoped_timer
@@ -59,6 +61,82 @@ class DKaMinPar:
             from ..presets import create_context_by_preset_name
 
             self.ctx = create_context_by_preset_name("default")
+
+    # -- mesh telemetry (round 13) -----------------------------------------
+
+    @staticmethod
+    def _shard_level_spans(rec, name, t0_us, dgraph, **args) -> None:
+        """Emit one span per shard lane for the level that just finished.
+
+        SPMD has one host program and one fused XLA program per step, so a
+        *measured* per-shard wall does not exist (dist/shard_stats.py) —
+        the lanes carry an explicit work-proportional ESTIMATE instead:
+        shard s's span is the level wall scaled by its owned-edge share of
+        the maximum (the max-work shard bounds the bulk-synchronous step,
+        so it gets the full measured wall).  The work quantities come from
+        the DistGraph's host-computed ``shard_work`` table — zero device
+        readbacks — and ride each span's args so ``tools trace --shards``
+        can summarize skew from span walls."""
+        if rec is None or not dgraph.shard_work:
+            return
+        t1 = rec._now_us()
+        edges = [max(int(w["owned_edges"]), 0) for w in dgraph.shard_work]
+        wmax = max(max(edges), 1)
+        for s, w in enumerate(dgraph.shard_work):
+            rec.lane_span(
+                f"shard{s}", name, t0_us, t0_us + (t1 - t0_us) * (edges[s] / wmax),
+                estimated="work-proportional", shard=s, **w, **args,
+            )
+
+    def _coarsen_level_budget(self) -> int:
+        """Blocking-transfer budget of ONE dist coarsening level (per-shard
+        currency via assert_phase_budget(shards=P)).  Every term is a
+        counted pull the level's drive loops perform; pathological
+        overflow-cap escalation re-pulls beyond the slack term can exceed
+        this, which is why budget checks are an armed test harness, not an
+        always-on assert (see utils/sync_stats.enable_budget_checks)."""
+        from ..context import DistClusteringAlgorithm as DCA
+
+        rounds = self.ctx.coarsening.lp.num_iterations
+        algo = self.ctx.coarsening.dist_clustering
+        cluster = 0
+        if algo in (DCA.GLOBAL_HEM, DCA.GLOBAL_HEM_LP):
+            cluster += rounds + 1  # per-round matched + final total pull
+        if algo in (DCA.LOCAL_LP, DCA.LOCAL_GLOBAL_LP):
+            cluster += rounds  # per-round moved pull
+        if algo in (DCA.GLOBAL_LP, DCA.LOCAL_GLOBAL_LP, DCA.GLOBAL_HEM_LP):
+            cluster += rounds  # per-round overflow pull
+        # contraction: packed (n_c, ovf) + s2 ovf + counts x2 + m_c +
+        # assembly x3 (global path; the local path uses one fewer)
+        contraction = 8
+        # The three overflow-adaptive cap loops (cluster rounds, _s1, _s2)
+        # each re-pull once per doubling; routine escalation on skewed
+        # graphs is a handful per level (measured 7 at scale 11/P=8), and
+        # the slack covers that without absorbing a per-round stray pull.
+        escalation_slack = 12
+        return cluster + contraction + escalation_slack
+
+    def _refine_call_budget(self) -> int:
+        """Blocking-transfer budget of ONE ``_refine`` call (the
+        ``dist_refinement`` phase): balancer round pulls + the per-round
+        convergence pulls of whichever refiners the context engages
+        (dist_edge_cut pulls attribute to ``dist_metrics``, not here)."""
+        from ..context import MoveExecutionStrategy, RefinementAlgorithm
+
+        r = self.ctx.refinement
+        budget = 16 + 8  # node-balancer rounds + cluster-balance escalation
+        if r.dist_move_execution in (
+            MoveExecutionStrategy.BEST_MOVES, MoveExecutionStrategy.LOCAL_MOVES
+        ):
+            budget += r.lp.num_iterations
+        if RefinementAlgorithm.CLP in r.algorithms:
+            # forced-count + num-colors pulls + per-superstep fences on the
+            # CPU backend (<= 97 colors under the 96-round JP cap) + one
+            # packed fence per iteration elsewhere
+            budget += 2 + r.clp.num_iterations * 98
+        if RefinementAlgorithm.JET in r.algorithms:
+            budget += (r.jet.num_iterations + 1) * (16 + 8)
+        return budget
 
     # -- pipeline ----------------------------------------------------------
 
@@ -117,10 +195,24 @@ class DKaMinPar:
         labels, dg = shard_arrays(self.mesh, dg, labels)
 
         # -- distributed coarsening ---------------------------------------
+        # Mesh telemetry (round 13): per-level shard-lane spans + quality
+        # rows ride the level's existing counted pulls (zero extra
+        # transfers), and the per-shard sync budget is asserted in-pipeline
+        # when enable_budget_checks armed it.
+        rec = ttrace.active()
+        if rec is not None:
+            rec.meta.setdefault("mesh_shards", P)
+        self._refine_calls = 0
+        self._refine_since = sync_stats.shard_phase_count("dist_refinement")[0]
+        self._refine_count_since = sync_stats.phase_count("dist_refinement")
+        coarsen_since = sync_stats.shard_phase_count("dist_coarsening")[0]
+        coarsen_count_since = sync_stats.phase_count("dist_coarsening")
+        coarsen_levels = 0
         self.hierarchy = []
         cur = dg
         with scoped_timer("dist_coarsening"):
             while cur.n > target_n:
+                t_lvl = rec._now_us() if rec is not None else 0.0
                 max_cw = max(
                     int(epsilon * total_w / max(min(cur.n // max(C, 1), k), 2)), 1
                 )
@@ -162,6 +254,15 @@ class DKaMinPar:
                     coarse, coarse_of, n_c = contract_dist_clustering(
                         self.mesh, cur, lab
                     )
+                coarsen_levels += 1
+                probes.dist_coarsening_level(
+                    level=coarsen_levels - 1, n=cur.n, m=cur.m, n_c=n_c,
+                    m_c=coarse.m, shards=P, max_cluster_weight=max_cw,
+                )
+                self._shard_level_spans(
+                    rec, "dist_coarsening_level", t_lvl, cur,
+                    level=coarsen_levels - 1,
+                )
                 if n_c < k:
                     # contraction overshot below k blocks — keep the finer
                     # graph so initial partitioning can still produce k
@@ -179,6 +280,16 @@ class DKaMinPar:
                     break
                 self.hierarchy.append(_Level(cur, coarse_of, coarse.n_loc))
                 cur = coarse
+        # Per-shard sync budget, asserted in-pipeline (round 13): every
+        # level's drive loops stay within the statically derived per-level
+        # pull allowance — a stray per-round readback regresses this
+        # immediately.  No-op unless sync_stats.enable_budget_checks armed.
+        sync_stats.assert_phase_budget(
+            "dist_coarsening",
+            self._coarsen_level_budget() * max(coarsen_levels, 1),
+            since=coarsen_since, shards=P,
+            count_since=coarsen_count_since,
+        )
 
         # -- initial partitioning: replicate coarsest -> shm pipeline ------
         # Deep scheme (else-branch below): the coarsest carries only
@@ -190,6 +301,7 @@ class DKaMinPar:
         # smaller coarsest).
         from ..partitioning.partition_utils import compute_k_for_n
 
+        ip_since = sync_stats.phase_count("dist_initial_partitioning")
         with scoped_timer("dist_initial_partitioning"):
             coarse_host = self._replicate_to_host(cur)
             if kway:
@@ -248,6 +360,13 @@ class DKaMinPar:
             # overlap the reps' device dispatches and GIL-releasing numpy.
             timer = Timer.global_()
             timer.disable()
+            # The nested shm replicas run their own armed budget asserts
+            # against process-global counters — concurrent replica threads
+            # alias each other's phases (utils/sync_stats.py docstring), so
+            # disarm for the pool's duration and re-arm after.
+            budget_armed = sync_stats.budget_checks_enabled()
+            if budget_armed:
+                sync_stats.enable_budget_checks(False)
             try:
                 import os as _os
 
@@ -262,6 +381,8 @@ class DKaMinPar:
                     )
             finally:
                 timer.enable()
+                if budget_armed:
+                    sync_stats.enable_budget_checks(True)
             # Mesh splitting (deep_multilevel.cc:80-96 / replicator.cc):
             # with R candidates and P divisible by R, refine + select on R
             # disjoint sub-meshes in one device program — the replica
@@ -300,16 +421,36 @@ class DKaMinPar:
             part = np.zeros(cur.N, dtype=np.int32)
             part[: cur.n] = part_host
             cur_k = k0
+        # Replicated-IP budget in plain transfer currency: one counted rep
+        # pull per replica + the mesh-split selection's cut-vector + winner
+        # pulls (the nested shm pipelines run under their OWN phase names).
+        sync_stats.assert_phase_budget(
+            "dist_initial_partitioning", reps + 4, since=ip_since,
+        )
 
         # -- uncoarsening: extend toward k + distributed refinement --------
         final_bw = np.full(k, max_bw_val, dtype=np.int64)
+        uncoarsen_since = sync_stats.shard_phase_count("dist_uncoarsening")[0]
+        uncoarsen_count_since = sync_stats.phase_count("dist_uncoarsening")
+        uncoarsen_levels = 0
         with scoped_timer("dist_uncoarsening"):
+            t_lvl = rec._now_us() if rec is not None else 0.0
             part_dev, cur_shard = shard_arrays(self.mesh, cur, jnp.asarray(part))
             part_dev, cur_k = self._extend_and_refine(
                 part_dev, cur_shard, cur_k, k, final_bw
             )
+            uncoarsen_levels += 1
+            probes.dist_uncoarsening_level(
+                level=len(self.hierarchy), n=cur_shard.n, m=cur_shard.m,
+                k=cur_k, shards=P,
+            )
+            self._shard_level_spans(
+                rec, "dist_uncoarsening_level", t_lvl, cur_shard,
+                level=len(self.hierarchy),
+            )
             while self.hierarchy:
                 level = self.hierarchy.pop()
+                t_lvl = rec._now_us() if rec is not None else 0.0
                 part_dev = project_partition_up(
                     self.mesh, level.coarse_of, part_dev,
                     n_loc_c=level.coarse_n_loc,
@@ -317,8 +458,36 @@ class DKaMinPar:
                 part_dev, cur_k = self._extend_and_refine(
                     part_dev, level.graph, cur_k, k, final_bw
                 )
+                uncoarsen_levels += 1
+                probes.dist_uncoarsening_level(
+                    level=len(self.hierarchy), n=level.graph.n,
+                    m=level.graph.m, k=cur_k, shards=P,
+                )
+                self._shard_level_spans(
+                    rec, "dist_uncoarsening_level", t_lvl, level.graph,
+                    level=len(self.hierarchy),
+                )
 
-        out = sync_stats.pull(part_dev)[: graph.n]
+        out = sync_stats.pull(
+            part_dev, phase="dist_uncoarsening", shards=P
+        )[: graph.n]
+        # Uncoarsening-phase budget (per-shard currency): per level at most
+        # the extension part pull + projection overflow pulls, plus the
+        # final partition readback.  The sharded device-extension path
+        # nests whole coarsening pipelines under this phase with
+        # data-dependent depth, so its budget is not asserted here.
+        if not self.ctx.initial_partitioning.device_extension:
+            sync_stats.assert_phase_budget(
+                "dist_uncoarsening", 4 * uncoarsen_levels + 1,
+                since=uncoarsen_since, shards=P,
+                count_since=uncoarsen_count_since,
+            )
+        sync_stats.assert_phase_budget(
+            "dist_refinement",
+            self._refine_call_budget() * max(self._refine_calls, 1),
+            since=getattr(self, "_refine_since", 0), shards=P,
+            count_since=getattr(self, "_refine_count_since", 0),
+        )
         if Logger.level.value >= OutputLevel.EXPERIMENT.value:
             # (dist_edge_cut computes the identical value on device — used
             # when the graph only exists sharded; here the host copy is free)
@@ -366,7 +535,9 @@ class DKaMinPar:
                 from ..partitioning.deep import extend_partition
 
                 host = self._replicate_to_host(dgraph)
-                part_host = sync_stats.pull(part_dev)[: dgraph.n].astype(np.int32)
+                part_host = sync_stats.pull(
+                    part_dev, shards=dgraph.num_shards
+                )[: dgraph.n].astype(np.int32)
                 import copy as _copy
 
                 ext_ctx = _copy.deepcopy(self.ctx)
@@ -393,7 +564,14 @@ class DKaMinPar:
 
     def _refine(self, part, dgraph: DistGraph, cap, k: int):
         """Balance → LP, the reference's refiner pipeline order
-        (dist factories.cc:95-131: NodeBalancer runs before LP/CLP/JET)."""
+        (dist factories.cc:95-131: NodeBalancer runs before LP/CLP/JET).
+        Runs under its own ``dist_refinement`` phase so the balancer/LP
+        convergence pulls budget separately from the uncoarsening spine."""
+        self._refine_calls = getattr(self, "_refine_calls", 0) + 1
+        with scoped_timer("dist_refinement"):
+            return self._refine_body(part, dgraph, cap, k)
+
+    def _refine_body(self, part, dgraph: DistGraph, cap, k: int):
         part, dgraph = shard_arrays(self.mesh, dgraph, part)
         part, feasible = dist_balance(
             self.mesh, RandomState.next_key(), part, dgraph, cap, k=k
@@ -423,7 +601,8 @@ class DKaMinPar:
                     self.mesh, RandomState.next_key(), out, dgraph, cap,
                     num_labels=k,
                 )
-                if int(moved) == 0:
+                # Counted per-round convergence readback (round 13).
+                if int(sync_stats.pull(moved, shards=dgraph.num_shards)) == 0:
                     break
         else:
             out, _ = dist_lp_iterate(
@@ -468,7 +647,9 @@ class DKaMinPar:
     def _replicate_to_host(self, dg: DistGraph) -> CSRGraph:
         """replicate_graph_everywhere analog: gather the coarse graph off the
         mesh and rebuild a host CSRGraph (reference: replicator.h:26)."""
-        node_w = sync_stats.pull(dg.node_w, phase="dist_extract")[: dg.n]
+        node_w = sync_stats.pull(
+            dg.node_w, phase="dist_extract", shards=dg.num_shards
+        )[: dg.n]
         src, dst, ww = dg.edges_global_host()
         edges = np.stack([src, dst], axis=1)
         return from_edge_list(

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .exchange import AXIS, ghost_exchange
+from .exchange import AXIS, ghost_exchange, psum
 from .lp import _neighbor_labels, _refine_round_body
 
 REP_AXIS = "rep"
@@ -76,7 +76,7 @@ def make_replicated_refine(mesh2: Mesh, *, num_labels: int, num_rounds: int):
         )
         nbr = _neighbor_labels(lab, ghosts, col_loc, 0)
         own = lab[edge_u]
-        cut2 = jax.lax.psum(
+        cut2 = psum(
             jnp.sum(jnp.where(own != nbr, edge_w, 0)), AXIS
         )
         return lab[None, :], cut2[None]
@@ -121,6 +121,6 @@ def refine_replicated(mesh: Mesh, key, parts_R: np.ndarray, coarse_host,
     # Two counted readbacks: the tiny (R,) cut vector first, then ONLY the
     # winning label row — pulling the whole (R, N) stack would be an R-fold
     # bandwidth regression on the best-of-R path.
-    cuts = sync_stats.pull(cuts2) // 2
+    cuts = sync_stats.pull(cuts2, shards=mesh.size) // 2
     best = int(np.argmin(cuts))
-    return sync_stats.pull(out_labels[best])[: coarse_host.n], cuts
+    return sync_stats.pull(out_labels[best], shards=S)[: coarse_host.n], cuts

@@ -60,6 +60,20 @@ class ShardStats:
             "imb": float(arr.max() / mean) if mean > 0 else 1.0,
         }
 
+    def imbalance_summary(self) -> dict:
+        """Aggregate max/mean imbalance over all rows (round 13): the
+        one-line shard-skew figure the 8-device dryrun artifacts carry
+        without post-processing.  ``max_imb`` names the worst row."""
+        if not self._order:
+            return {"max_imb": 1.0, "mean_imb": 1.0, "worst": None}
+        imbs = {name: self.stats(name)["imb"] for name in self._order}
+        worst = max(imbs, key=lambda n: imbs[n])
+        return {
+            "max_imb": round(imbs[worst], 4),
+            "mean_imb": round(sum(imbs.values()) / len(imbs), 4),
+            "worst": worst,
+        }
+
     def render(self) -> str:
         if not self._order:
             return "(no shard statistics recorded)"
@@ -74,10 +88,16 @@ class ShardStats:
                 f"  {name:<{width}}  {s['min']:>12.1f} / {s['mean']:>12.1f} / "
                 f"{s['max']:>12.1f}  (imb {s['imb']:.2f})"
             )
+        agg = self.imbalance_summary()
+        lines.append(
+            f"  {'imbalance':<{width}}  max {agg['max_imb']:.2f} "
+            f"({agg['worst']}) / mean {agg['mean_imb']:.2f}"
+        )
         return "\n".join(lines)
 
     def machine_readable(self) -> str:
-        """One SHARDSTAT line per row (greppable, like TIME/RESULT lines)."""
+        """One SHARDSTAT line per row plus a SHARDSTAT_SUMMARY aggregate
+        (greppable, like TIME/RESULT lines)."""
         out = []
         for name in self._order:
             s = self.stats(name)
@@ -85,15 +105,34 @@ class ShardStats:
                 f"SHARDSTAT {name} min={s['min']:.1f} mean={s['mean']:.1f} "
                 f"max={s['max']:.1f} imb={s['imb']:.4f}"
             )
+        if self._order:
+            agg = self.imbalance_summary()
+            out.append(
+                f"SHARDSTAT_SUMMARY max_imb={agg['max_imb']:.4f} "
+                f"mean_imb={agg['mean_imb']:.4f} worst={agg['worst']}"
+            )
         return "\n".join(out)
 
 
 def collect_graph_stats(dgraph) -> ShardStats:
     """Static layout statistics of a DistGraph: the load table the reference
-    prints when a distributed graph is read (nodes/edges/ghosts per PE)."""
+    prints when a distributed graph is read (nodes/edges/ghosts per PE).
+
+    Round 13: when the graph carries its build-time ``shard_work`` table
+    (distribute_graph and the contraction assembly both populate it from
+    arrays already host-resident) the collection costs ZERO device
+    readbacks, so shard stats can ride every level of a telemetry-armed
+    run; the counted-pull path below remains for graphs built without it
+    (e.g. the compressed loader)."""
     P = dgraph.num_shards
     n_loc = dgraph.n_loc
     st = ShardStats(P)
+
+    if dgraph.shard_work:
+        for key in ("owned_nodes", "owned_edges", "ghost_nodes",
+                    "interface_nodes"):
+            st.record(key, [w[key] for w in dgraph.shard_work])
+        return st
 
     owned = np.array(
         [max(0, min((s + 1) * n_loc, dgraph.n) - s * n_loc) for s in range(P)],
@@ -105,7 +144,8 @@ def collect_graph_stats(dgraph) -> ShardStats:
     from ..utils import sync_stats
 
     edge_w, send = sync_stats.pull(
-        dgraph.edge_w, dgraph.send_idx, phase="dist_stats"
+        dgraph.edge_w, dgraph.send_idx, phase="dist_stats",
+        shards=dgraph.num_shards,
     )
     edge_w = edge_w.reshape(P, dgraph.m_loc)
     st.record("owned_edges", (edge_w > 0).sum(axis=1))

@@ -174,6 +174,18 @@ class ServeStats:
         sync_snap = sync_stats.snapshot()
         out["host_sync_count"] = sync_snap["count"]
         out["host_sync_bytes"] = sync_snap["bytes"]
+        # Collective-traffic census (round 13, utils/collective_stats.py):
+        # traced psum/all_to_all/all_gather ops + logical bytes — zero for
+        # a pure-shm engine, populated the moment a dist/mesh pipeline
+        # shares the process.
+        from ..utils import collective_stats
+
+        coll = collective_stats.snapshot()
+        out["collective_count"] = coll["count"]
+        out["collective_logical_bytes"] = coll["logical_bytes"]
+        out["collective_by_op"] = {
+            op: row["count"] for op, row in coll["by_op"].items()
+        }
         return out
 
     def prometheus_families(
@@ -254,6 +266,16 @@ class ServeStats:
             ("kaminpar_serve_host_sync_bytes_total", "counter",
              "Bytes moved by blocking device-to-host transfers (process-wide)",
              [({}, snap["host_sync_bytes"])]),
+            ("kaminpar_collective_ops_total", "counter",
+             "Traced mesh collectives by op (process-wide census; counts "
+             "are per compiled specialization, see utils/collective_stats)",
+             [({"op": op}, count)
+              for op, count in sorted(snap["collective_by_op"].items())]
+             or [({}, 0)]),
+            ("kaminpar_collective_logical_bytes_total", "counter",
+             "Logical payload bytes of traced mesh collectives "
+             "(per-shard operand bytes x axis size; not wire bytes)",
+             [({}, snap["collective_logical_bytes"])]),
             ("kaminpar_serve_compiled_shapes", "gauge",
              "Distinct compiled kernel specializations (process-wide census)",
              [({}, snap["compiled_shape_count"].get("total", 0))]),

@@ -1,0 +1,337 @@
+"""Persistent run ledger + regression sentinel (round 13).
+
+Every headline number so far lives in write-once artifacts (BENCH_r0x.json,
+TPU_RESULT.json) with no machinery to compare runs over time (ROADMAP item
+5).  The ledger fixes that: each bench / prober / serve run appends ONE
+compact JSON line to ``RUNS.jsonl`` — git head, device kind, the record's
+numeric headline metrics, per-phase walls, and the sync / collective /
+compile censuses plus the kptlint summary — and ``tools regress`` compares
+the latest entry against a baseline window of earlier entries with
+noise-aware thresholds, exiting nonzero on regression.  This is the
+recorded-probe substrate ROADMAP item 5's future ``tools autotune`` reads
+from: entries are append-only, schema-versioned, and cheap enough to write
+on every run.
+
+Direction semantics for :func:`compare`: wall/latency/cut/census metrics
+are lower-better; throughput/ratio metrics are higher-better (the key
+classifier below).  Wall metrics use a relative tolerance over the
+baseline *median* (single-run walls on shared boxes are noisy) plus an
+absolute floor; census counts are deterministic per build, so they use the
+baseline *max* with zero default tolerance — one stray blocking transfer
+or collective is a real regression, not noise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+SCHEMA = 1
+DEFAULT_WINDOW = 5
+#: Relative wall tolerance: BENCH_r0x partition walls on this box vary by
+#: ~±30% rep to rep (TPU_NOTES round 11), so anything tighter cries wolf.
+DEFAULT_WALL_TOL = 0.35
+DEFAULT_COUNT_TOL = 0.0
+#: Quality (cut) tolerance: seeds are pinned, but refinement tie-breaks
+#: can drift a few percent across environments.
+DEFAULT_QUALITY_TOL = 0.10
+_ABS_WALL_FLOOR_S = 0.05
+
+_HIGHER_BETTER_MARKERS = (
+    "_gps", "edges_per_sec", "_rate", "vs_baseline", "_vs_", "gbps",
+    "frac_of_peak",
+)
+_LOWER_BETTER_MARKERS = (
+    "_s", "_ms", "_cut", "cut", "count", "bytes", "_shapes", "fallbacks",
+    "splits", "timed_out", "fresh",
+)
+
+
+def default_path() -> str:
+    """RUNS.jsonl next to the repo root (overridable via KPTPU_RUNS_PATH)."""
+    env = os.environ.get("KPTPU_RUNS_PATH")
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "RUNS.jsonl")
+
+
+def metric_direction(key: str) -> Optional[str]:
+    """'up' (higher is better), 'down' (lower is better), or None
+    (uncompared).  Higher-better markers win ties: ``serve_vs_single`` is a
+    ratio even though it has no unit suffix."""
+    if key == "value":  # the LP-microbench headline (edges/sec)
+        return "up"
+    for marker in _HIGHER_BETTER_MARKERS:
+        if marker in key:
+            return "up"
+    for marker in _LOWER_BETTER_MARKERS:
+        if key.endswith(marker) or marker in key:
+            return "down"
+    return None
+
+
+def _numeric_metrics(record: dict) -> Dict[str, float]:
+    out = {}
+    for key, value in record.items():
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        out[key] = value
+    return out
+
+
+def build_entry(record: dict, *, kind: str, git_head: str = "",
+                extra: dict | None = None) -> dict:
+    """One compact ledger entry from a bench/prober/serve headline record.
+
+    Census snapshots come from the record when the measuring process
+    embedded them (the bench children do) and fall back to this process's
+    own counters — so both the in-process CPU path and the salvage path
+    produce comparable entries.
+    """
+    from ..utils import collective_stats, compile_stats, sync_stats
+
+    sync = record.get("host_sync")
+    sync_totals = {
+        "count": record.get("host_sync_count"),
+        "bytes": record.get("host_sync_bytes"),
+    }
+    if sync_totals["count"] is None:
+        snap = sync_stats.snapshot()
+        sync_totals = {
+            "count": snap["count"], "bytes": snap["bytes"],
+            "implicit": snap["implicit"],
+            "lane_pulls": snap["lane_pulls"],
+            "shard_pulls": snap["shard_pulls"],
+        }
+        sync = {
+            ph: row["count"] for ph, row in snap["phases"].items()
+        }
+    else:
+        sync = {
+            ph: row.get("count") for ph, row in (sync or {}).items()
+        }
+
+    coll = record.get("collectives") or collective_stats.snapshot()
+    compile_snap = record.get("compiled_shape_count") or compile_stats.snapshot()
+
+    entry = {
+        "schema": SCHEMA,
+        "ts": round(time.time(), 1),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "kind": kind,
+        "git_head": git_head or record.get("git_head") or "",
+        "backend": record.get("backend", ""),
+        "device_kind": record.get("device_kind", ""),
+        "stale_vs_head": bool(record.get("stale_vs_head", False)),
+        "metrics": _numeric_metrics(record),
+        "phase_walls_s": record.get("phase_walls_s") or phase_walls(),
+        "sync_phases": sync,
+        "sync": sync_totals,
+        "collectives": {
+            "count": coll.get("count", 0),
+            "logical_bytes": coll.get("logical_bytes", 0),
+            "by_op": {
+                op: row.get("count", 0)
+                for op, row in (coll.get("by_op") or {}).items()
+            },
+        },
+        "compiled_shapes": compile_snap.get("total", 0)
+        if isinstance(compile_snap, dict) else compile_snap,
+        "lint": record.get("lint"),
+    }
+    if extra:
+        entry.update(extra)
+    return entry
+
+
+def phase_walls() -> Dict[str, float]:
+    """Top-level phase walls from this process's merged timer tree."""
+    try:
+        from ..utils import Timer
+
+        root = Timer.global_().merged_root()
+        return {
+            child.name: round(child.elapsed, 4)
+            for child in root.children.values()
+            if child.elapsed > 0
+        }
+    except Exception:  # noqa: BLE001 — ledger writes must never fail a run
+        return {}
+
+
+def append(entry: dict, path: str | None = None) -> str:
+    path = path or default_path()
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    return path
+
+
+def read(path: str | None = None) -> List[dict]:
+    path = path or default_path()
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # a torn write must not poison the whole ledger
+    return out
+
+
+def tail(n: int = 10, path: str | None = None) -> List[dict]:
+    return read(path)[-n:]
+
+
+def record_run(record: dict, *, kind: str, git_head: str = "",
+               path: str | None = None) -> Optional[str]:
+    """Build + append in one guarded step (the bench/prober entry point).
+    Returns the path, or None when disabled (KPTPU_LEDGER=0) or failed —
+    a ledger problem must never void the run's own artifact."""
+    if os.environ.get("KPTPU_LEDGER", "1") == "0":
+        return None
+    try:
+        return append(build_entry(record, kind=kind, git_head=git_head), path)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# -- regression sentinel -----------------------------------------------------
+
+
+def _median(values: List[float]) -> float:
+    vs = sorted(values)
+    mid = len(vs) // 2
+    if len(vs) % 2:
+        return float(vs[mid])
+    return (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def _flat_comparables(entry: dict) -> Dict[str, float]:
+    """The metrics a regression check covers: the record's numeric headline
+    metrics plus the census totals (namespaced so they cannot collide)."""
+    out = dict(entry.get("metrics") or {})
+    sync = entry.get("sync") or {}
+    if sync.get("count") is not None:
+        out["census.host_sync_count"] = sync["count"]
+    coll = entry.get("collectives") or {}
+    if coll.get("count") is not None:
+        out["census.collective_count"] = coll["count"]
+    if entry.get("compiled_shapes") is not None:
+        out["census.compiled_shapes"] = entry["compiled_shapes"]
+    for phase, wall in (entry.get("phase_walls_s") or {}).items():
+        out[f"phase.{phase}_s"] = wall
+    return out
+
+
+def compare(latest: dict, baseline: List[dict], *,
+            wall_tol: float = DEFAULT_WALL_TOL,
+            count_tol: float = DEFAULT_COUNT_TOL,
+            quality_tol: float = DEFAULT_QUALITY_TOL) -> List[dict]:
+    """Regressions of ``latest`` vs a window of baseline entries.
+
+    Noise model per metric class:
+
+    - **walls / latencies** (``*_s``/``*_ms``): regression when latest
+      exceeds the baseline *median* by ``wall_tol`` relatively AND by an
+      absolute floor (sub-50 ms jitter never flags).
+    - **censuses** (``census.*``: blocking transfers, traced collectives,
+      compiled shapes): deterministic per build — regression when latest
+      exceeds the baseline *max* by more than ``count_tol`` relatively.
+    - **quality** (``*cut*``): ``quality_tol`` over the median.
+    - **throughputs / ratios** (higher-better): regression when latest
+      falls below median * (1 - wall_tol).
+
+    Returns one dict per regression; an identical replay returns [].
+    """
+    regressions = []
+    latest_vals = _flat_comparables(latest)
+    base_vals: Dict[str, List[float]] = {}
+    for entry in baseline:
+        for key, value in _flat_comparables(entry).items():
+            base_vals.setdefault(key, []).append(float(value))
+
+    for key, value in latest_vals.items():
+        base = base_vals.get(key)
+        if not base:
+            continue
+        value = float(value)
+        if key.startswith("census."):
+            limit = max(base) * (1.0 + count_tol)
+            if value > limit:
+                regressions.append({
+                    "metric": key, "latest": value, "baseline_max": max(base),
+                    "threshold": round(limit, 4), "direction": "down",
+                    "class": "census",
+                })
+            continue
+        med = _median(base)
+        if "cut" in key:
+            limit = med * (1.0 + quality_tol)
+            if value > limit:
+                regressions.append({
+                    "metric": key, "latest": value, "baseline_median": med,
+                    "threshold": round(limit, 4), "direction": "down",
+                    "class": "quality",
+                })
+            continue
+        direction = metric_direction(key)
+        if direction == "down":
+            limit = med * (1.0 + wall_tol)
+            if value > limit and value - med > _ABS_WALL_FLOOR_S:
+                regressions.append({
+                    "metric": key, "latest": value, "baseline_median": med,
+                    "threshold": round(limit, 4), "direction": "down",
+                    "class": "wall",
+                })
+        elif direction == "up":
+            limit = med * (1.0 - wall_tol)
+            if value < limit:
+                regressions.append({
+                    "metric": key, "latest": value, "baseline_median": med,
+                    "threshold": round(limit, 4), "direction": "up",
+                    "class": "throughput",
+                })
+    return regressions
+
+
+#: Workload-configuration metrics: entries disagreeing on any of these are
+#: different experiments, not baselines for each other (a scale-17 wall
+#: judged against a scale-9 window would flag everything).
+_CONFIG_KEYS = ("partition_scale", "partition_k", "serve_k",
+                "serve_requests")
+
+
+def baseline_window(entries: List[dict], latest: dict,
+                    window: int = DEFAULT_WINDOW) -> List[dict]:
+    """The comparable baseline for ``latest``: the most recent earlier
+    entries of the same kind AND backend (a cpu-fallback run must never be
+    judged against a TPU window) AND the same workload configuration
+    (scale/k), newest last, at most ``window``."""
+    latest_cfg = {
+        key: (latest.get("metrics") or {}).get(key) for key in _CONFIG_KEYS
+    }
+
+    def comparable(entry: dict) -> bool:
+        if (
+            entry is latest
+            or entry.get("kind") != latest.get("kind")
+            or entry.get("backend") != latest.get("backend")
+        ):
+            return False
+        metrics = entry.get("metrics") or {}
+        return all(
+            value is None or metrics.get(key) is None
+            or metrics.get(key) == value
+            for key, value in latest_cfg.items()
+        )
+
+    return [e for e in entries if comparable(e)][-window:]

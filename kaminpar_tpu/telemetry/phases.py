@@ -38,6 +38,10 @@ CORE_PHASES = (
     "dist_coarsening",
     "dist_initial_partitioning",
     "dist_uncoarsening",
+    # dist refinement drive (round 13): balancer/LP/CLP/JET convergence
+    # pulls budget separately from the uncoarsening spine, mirroring the
+    # shm split between "uncoarsening" and the per-refiner phases.
+    "dist_refinement",
 )
 
 # Phases pushed outside the spine: serve-runtime internals and the bench

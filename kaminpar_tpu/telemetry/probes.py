@@ -119,6 +119,42 @@ def uncoarsening_level(*, level, n, m, k, cut=None, max_block_weight=None,
     )
 
 
+def dist_coarsening_level(*, level, n, m, n_c, m_c, shards,
+                          max_cluster_weight=None) -> None:
+    """Per-level quality row of the dist tier (round 13): every value is a
+    host int the pipeline already holds (n/m from the level's DistGraph
+    metadata, n_c/m_c from the contraction's own counted readbacks) — the
+    probe adds zero transfers, riding the existing dist_* pulls."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.quality_row(
+        "dist_coarsening_level",
+        level=int(level), n=int(n), m=int(m), n_c=int(n_c), m_c=int(m_c),
+        shrink=round(1.0 - n_c / max(n, 1), 4),
+        shards=int(shards),
+        max_cluster_weight=(
+            int(max_cluster_weight) if max_cluster_weight is not None else None
+        ),
+    )
+
+
+def dist_uncoarsening_level(*, level, n, m, k, shards, cut=None,
+                            feasible=None) -> None:
+    """Uncoarsening-side dist quality row; ``cut``/``feasible`` are passed
+    only when an existing readback already produced them (never pulled
+    here)."""
+    rec = _rec()
+    if rec is None:
+        return
+    rec.quality_row(
+        "dist_uncoarsening_level",
+        level=int(level), n=int(n), m=int(m), k=int(k), shards=int(shards),
+        cut=int(cut) if cut is not None else None,
+        feasible=bool(feasible) if feasible is not None else None,
+    )
+
+
 def pull_partition_with_quality(p_graph, *, level, kind="level_quality"):
     """Pull a partition to the host — the spine's existing per-level
     readback — and, when telemetry is armed, let the level's cut and max

@@ -139,6 +139,46 @@ class TraceRecorder:
             else:
                 self.dropped_events += 1
 
+    def lane_tid(self, lane: str) -> int:
+        """tid of a *synthetic* lane row (e.g. per-shard mesh lanes,
+        round 13) — named via thread_name metadata like real threads, but
+        fed by :meth:`lane_span` with explicit timestamps instead of the
+        ambient clock.  Lane keys live in the same tid namespace as thread
+        idents (string keys cannot collide with ints)."""
+        key = f"lane:{lane}"
+        tid = self._tids.get(key)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.get(key)
+                if tid is None:
+                    tid = self._tids[key] = len(self._tids)
+                    self._events.append({
+                        "name": "thread_name", "ph": "M", "ts": 0.0,
+                        "pid": _PID, "tid": tid, "args": {"name": lane},
+                    })
+        return tid
+
+    def lane_span(self, lane: str, name: str, ts_begin_us: float,
+                  ts_end_us: float, **args) -> None:
+        """Append one CLOSED span on a synthetic lane row with explicit
+        timestamps (monotonic per lane as long as callers emit spans in
+        chronological order, which the sequential dist pipeline does).  The
+        B/E pair is admitted or dropped atomically so the cap can never
+        orphan half a span."""
+        tid = self.lane_tid(lane)
+        t0 = float(ts_begin_us)
+        t1 = float(max(ts_end_us, ts_begin_us))
+        b = {"name": name, "ph": "B", "ts": t0, "pid": _PID, "tid": tid}
+        if args:
+            b["args"] = args
+        e = {"name": name, "ph": "E", "ts": t1, "pid": _PID, "tid": tid}
+        with self._lock:
+            if len(self._events) + 1 >= self.max_events:
+                self.dropped_events += 2
+                return
+            self._events.append(b)
+            self._events.append(e)
+
     def instant(self, name: str, **args) -> None:
         ev = {"name": name, "ph": "i", "s": "t", "ts": self._now_us(),
               "pid": _PID, "tid": self._tid()}
@@ -318,6 +358,61 @@ def run(trace_out: str = "", profile_phases=(), profile_dir: str = ""):
                     RuntimeWarning,
                     stacklevel=2,
                 )
+
+
+def shard_lane_summary(obj: dict) -> list:
+    """Per-shard imbalance from the mesh lanes' span walls (round 13).
+
+    The dist pipeline emits per-level spans on synthetic ``shardN`` lanes
+    whose walls are work-proportional estimates of each shard's share of
+    the bulk-synchronous level (dist/partitioner.py — a measured per-shard
+    wall does not exist under SPMD).  Returns one row per span name:
+    ``{name, walls_ms: [per-shard summed wall], min_ms, mean_ms, max_ms,
+    imb}`` with imb = max/mean, the reference's dist-timer convention."""
+    import re as _re
+
+    events = obj.get("traceEvents") or []
+    lane_of_tid = {}
+    for ev in events:
+        if (
+            ev.get("ph") == "M"
+            and ev.get("name") == "thread_name"
+            and _re.fullmatch(r"shard\d+", (ev.get("args") or {}).get("name", ""))
+        ):
+            lane_of_tid[(ev.get("pid"), ev.get("tid"))] = int(
+                ev["args"]["name"][5:]
+            )
+    if not lane_of_tid:
+        return []
+    num_shards = max(lane_of_tid.values()) + 1
+    walls: Dict[str, list] = {}
+    open_b: Dict[tuple, list] = {}
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        if key not in lane_of_tid:
+            continue
+        if ev.get("ph") == "B":
+            open_b.setdefault(key, []).append(ev)
+        elif ev.get("ph") == "E":
+            stack = open_b.get(key)
+            if not stack:
+                continue
+            b = stack.pop()
+            row = walls.setdefault(b["name"], [0.0] * num_shards)
+            row[lane_of_tid[key]] += (ev["ts"] - b["ts"]) / 1e3
+    out = []
+    for name in sorted(walls):
+        ms = walls[name]
+        mean = sum(ms) / max(len(ms), 1)
+        out.append({
+            "name": name,
+            "walls_ms": [round(v, 3) for v in ms],
+            "min_ms": round(min(ms), 3),
+            "mean_ms": round(mean, 3),
+            "max_ms": round(max(ms), 3),
+            "imb": round(max(ms) / mean, 4) if mean > 0 else 1.0,
+        })
+    return out
 
 
 # -- validation (tools trace / tier-1 smoke tests) ---------------------------

@@ -17,7 +17,9 @@ def main(argv=None) -> int:
     if name not in tools.REGISTRY:
         print(f"unknown tool '{name}'; available: {sorted(tools.REGISTRY)}")
         return 1
-    if name != "lint":  # lint is pure-AST and must stay jax-free
+    # lint is pure-AST and the ledger/regress pair is pure-JSON — none may
+    # touch jax (a dead tunnel must not wedge the CI gates).
+    if name not in ("lint", "ledger", "regress"):
         from ..utils.platform import prefer_working_backend
 
         prefer_working_backend()

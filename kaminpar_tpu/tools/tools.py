@@ -195,8 +195,12 @@ def trace(argv) -> int:
                    help="re-emit the validated trace to this path")
     p.add_argument("--quality", action="store_true",
                    help="print the per-level quality rows as JSON lines")
+    p.add_argument("--shards", action="store_true",
+                   help="summarize per-shard imbalance from the mesh "
+                        "lanes' span walls (round 13: the dist pipeline "
+                        "emits work-proportional shard-lane spans)")
     args = p.parse_args(argv)
-    from ..telemetry.trace import validate_chrome_trace
+    from ..telemetry.trace import shard_lane_summary, validate_chrome_trace
 
     with open(args.trace) as fh:
         obj = json.load(fh)
@@ -213,6 +217,19 @@ def trace(argv) -> int:
     print(f"  span names: {', '.join(summary['span_names']) or '(none)'}")
     print(f"  counter tracks: {', '.join(summary['counter_names']) or '(none)'}")
     print(f"  quality rows: {summary['quality_rows']}")
+    if args.shards:
+        rows = shard_lane_summary(obj)
+        if not rows:
+            print("  shard lanes: (none — not a mesh trace)")
+        else:
+            print(f"  shard-lane walls over {len(rows[0]['walls_ms'])} shards "
+                  "(work-proportional estimates; imb = max/mean):")
+            for row in rows:
+                print(
+                    f"    {row['name']}: min {row['min_ms']:.2f} / mean "
+                    f"{row['mean_ms']:.2f} / max {row['max_ms']:.2f} ms "
+                    f"(imb {row['imb']:.2f})"
+                )
     if args.quality:
         for row in other.get("quality", []):
             print(json.dumps(row))
@@ -221,6 +238,130 @@ def trace(argv) -> int:
             json.dump(obj, fh)
         print(f"re-emitted {summary['events']} events to {args.out}")
     return 0
+
+
+def ledger(argv) -> int:
+    """Run-ledger maintenance (round 13; see telemetry/ledger.py): every
+    bench/prober run appends one compact JSON line to RUNS.jsonl —
+    ``show`` prints compact per-entry lines, ``tail`` the raw JSON,
+    ``append`` adds an entry built from a headline record file (the manual
+    path for artifacts produced elsewhere)."""
+    import json
+
+    p = argparse.ArgumentParser(prog="ledger")
+    p.add_argument("action", choices=["show", "tail", "append"])
+    p.add_argument("--runs", default=None, metavar="PATH",
+                   help="ledger path (default: RUNS.jsonl in the repo root)")
+    p.add_argument("-n", type=int, default=10,
+                   help="entries to show/tail (default 10)")
+    p.add_argument("--from-json", default=None, metavar="FILE",
+                   help="append: headline record JSON to build the entry from")
+    p.add_argument("--kind", default="manual",
+                   help="append: entry kind (default 'manual')")
+    args = p.parse_args(argv)
+    from ..telemetry import ledger as led
+
+    path = args.runs or led.default_path()
+    if args.action == "append":
+        if not args.from_json:
+            print("error: append requires --from-json FILE")
+            return 1
+        with open(args.from_json) as fh:
+            record = json.load(fh)
+        led.append(led.build_entry(record, kind=args.kind), path)
+        print(f"appended 1 {args.kind} entry to {path}")
+        return 0
+    entries = led.tail(args.n, path)
+    if not entries:
+        print(f"(no ledger entries at {path})")
+        return 0
+    if args.action == "tail":
+        for entry in entries:
+            print(json.dumps(entry))
+        return 0
+    for entry in entries:
+        metrics = entry.get("metrics") or {}
+        headline = " ".join(
+            f"{key}={metrics[key]}" for key in
+            ("value", "partition_wall_s", "partition_cut",
+             "serve_throughput_gps")
+            if key in metrics
+        )
+        sync = (entry.get("sync") or {}).get("count")
+        coll = (entry.get("collectives") or {}).get("count")
+        print(
+            f"{entry.get('iso', '?'):>19}  {entry.get('kind', '?'):<7} "
+            f"{entry.get('backend', '?'):<12} head={entry.get('git_head') or '?':<9} "
+            f"sync={sync} coll={coll} {headline}"
+        )
+    return 0
+
+
+def regress(argv) -> int:
+    """Regression sentinel (round 13): compare the newest RUNS.jsonl entry
+    against a baseline window of earlier same-kind/same-backend entries
+    with noise-aware thresholds (telemetry/ledger.compare).  Exit 1 on any
+    regression, 0 when quiet — the CI gate over the run ledger."""
+    import json
+
+    p = argparse.ArgumentParser(prog="regress")
+    p.add_argument("--runs", default=None, metavar="PATH")
+    p.add_argument("--window", type=int, default=None,
+                   help="baseline entries to compare against (default 5)")
+    p.add_argument("--wall-tol", type=float, default=None,
+                   help="relative wall/throughput tolerance (default 0.35)")
+    p.add_argument("--count-tol", type=float, default=None,
+                   help="relative census tolerance (default 0.0 — one "
+                        "stray transfer or collective is a regression)")
+    p.add_argument("--quality-tol", type=float, default=None,
+                   help="relative cut tolerance (default 0.10)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    from ..telemetry import ledger as led
+
+    entries = led.read(args.runs)
+    if not entries:
+        print("regress: ledger is empty — nothing to compare")
+        return 0
+    latest = entries[-1]
+    window = led.baseline_window(
+        entries[:-1], latest, args.window or led.DEFAULT_WINDOW
+    )
+    if not window:
+        print(
+            f"regress: no baseline window for kind={latest.get('kind')!r} "
+            f"backend={latest.get('backend')!r} — nothing to compare"
+        )
+        return 0
+    kwargs = {}
+    if args.wall_tol is not None:
+        kwargs["wall_tol"] = args.wall_tol
+    if args.count_tol is not None:
+        kwargs["count_tol"] = args.count_tol
+    if args.quality_tol is not None:
+        kwargs["quality_tol"] = args.quality_tol
+    regressions = led.compare(latest, window, **kwargs)
+    if args.as_json:
+        print(json.dumps({
+            "latest_iso": latest.get("iso"),
+            "baseline_entries": len(window),
+            "regressions": regressions,
+        }))
+    else:
+        print(
+            f"regress: latest {latest.get('iso')} ({latest.get('kind')}/"
+            f"{latest.get('backend')}) vs {len(window)} baseline entries"
+        )
+        for reg in regressions:
+            ref = reg.get("baseline_median", reg.get("baseline_max"))
+            print(
+                f"  REGRESSION {reg['metric']}: {reg['latest']} vs "
+                f"baseline {ref} (threshold {reg['threshold']}, "
+                f"{reg['class']})"
+            )
+        if not regressions:
+            print("  no regressions")
+    return 1 if regressions else 0
 
 
 def lint(argv) -> int:
@@ -236,11 +377,13 @@ def lint(argv) -> int:
 
 REGISTRY = {
     "graph-properties": graph_properties,
+    "ledger": ledger,
     "lint": lint,
     "partition-properties": partition_properties,
     "connected-components": connected_components,
-    "rearrange": rearrange,
     "compression": compression,
+    "rearrange": rearrange,
+    "regress": regress,
     "warmup": warmup,
     "trace": trace,
 }

@@ -1,0 +1,126 @@
+"""Collective-traffic census for the dist/mesh tier (round 13).
+
+The reference treats communication volume as a first-class engineering
+object: ``kaminpar-dist`` sits on a dedicated sparse/grid all-to-all layer
+(kaminpar-mpi/sparse_alltoall.h, grid_alltoall.h) whose wrappers count
+messages and bytes per algorithm phase.  The TPU port's collectives are XLA
+ops inside ``shard_map`` programs — invisible to host-side accounting — so
+this module mirrors what :mod:`utils.compile_stats` does for compiled
+shapes: the counted wrappers in :mod:`kaminpar_tpu.dist.exchange` call
+:func:`record` at **trace time** (Python inside a jitted body runs once per
+compiled specialization, never per execution), so the census costs zero
+collectives, zero readbacks, and zero per-execution work by construction.
+
+Semantics (TPU_NOTES.md round 13):
+
+- **op counts** are per *traced program*, attributed to the sync/timer
+  phase active when the program was first traced (phases come from the
+  same thread-local stack :mod:`utils.sync_stats` uses).  A cached
+  executable re-executing adds nothing — exactly like the compiled-shape
+  census.  One LP round body therefore contributes a fixed, hand-countable
+  number of psum/all_to_all ops (asserted in tests/test_mesh_telemetry.py).
+- **logical bytes** come from static traced shapes: per-shard operand
+  bytes x mesh axis size (every shard contributes its operand).  This is
+  the *logical* payload of the collective, not wire bytes — a psum on a
+  ring moves ~2x the operand per hop and an all_to_all keeps 1/P of its
+  buffer local; pad slots are counted because the device moves them too.
+  Logical bytes are the quantity the static-routing design controls
+  (cap_g / cap_q buffer sizing), which is why they are the census currency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ..telemetry import trace as _ttrace
+
+_lock = threading.Lock()
+# phase -> {op -> [count, logical_bytes]}
+_counts: Dict[str, Dict[str, list]] = {}
+
+
+def _phase() -> str:
+    from . import sync_stats
+
+    return sync_stats._phase()
+
+
+def record(op: str, nbytes: int, axis_size: int, count: int = 1,
+           phase: str | None = None) -> None:
+    """Count one traced collective: ``nbytes`` is the per-shard operand
+    size; logical bytes = nbytes x axis_size.  Called from inside traced
+    bodies (runs once per compile), so keep it allocation-light."""
+    ph = phase or _phase()
+    logical = int(nbytes) * int(axis_size) * count
+    with _lock:
+        ops = _counts.get(ph)
+        if ops is None:
+            ops = _counts[ph] = {}
+        row = ops.get(op)
+        if row is None:
+            row = ops[op] = [0, 0]
+        row[0] += count
+        row[1] += logical
+        total_count = sum(r[0] for o in _counts.values() for r in o.values())
+        total_bytes = sum(r[1] for o in _counts.values() for r in o.values())
+    rec = _ttrace.active()
+    if rec is not None:
+        # Counter track mirrors host_sync: one sample per newly traced
+        # collective — the track shows exactly the trace/compile bursts.
+        rec.counter("collectives", {
+            "count": total_count, "logical_bytes": total_bytes,
+        })
+
+
+def traced_bytes(shape, dtype) -> int:
+    """Per-shard operand bytes of a traced aval (static shapes only)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    import numpy as np
+
+    return n * int(np.dtype(dtype).itemsize)
+
+
+def phase_ops(name: str) -> Dict[str, int]:
+    """{op: traced count} of phase ``name`` (empty dict when unseen)."""
+    with _lock:
+        ops = _counts.get(name)
+        return {op: row[0] for op, row in sorted(ops.items())} if ops else {}
+
+
+def snapshot() -> dict:
+    """{phases: {phase: {ops: {op: {count, logical_bytes}}, count,
+    logical_bytes}}, count, logical_bytes, by_op} — the collective census
+    bench.py / the ledger embed."""
+    with _lock:
+        phases = {}
+        by_op: Dict[str, Dict[str, int]] = {}
+        for ph, ops in sorted(_counts.items()):
+            rows = {
+                op: {"count": r[0], "logical_bytes": r[1]}
+                for op, r in sorted(ops.items())
+            }
+            phases[ph] = {
+                "ops": rows,
+                "count": sum(r["count"] for r in rows.values()),
+                "logical_bytes": sum(
+                    r["logical_bytes"] for r in rows.values()
+                ),
+            }
+            for op, r in rows.items():
+                agg = by_op.setdefault(op, {"count": 0, "logical_bytes": 0})
+                agg["count"] += r["count"]
+                agg["logical_bytes"] += r["logical_bytes"]
+    return {
+        "phases": phases,
+        "by_op": by_op,
+        "count": sum(p["count"] for p in phases.values()),
+        "logical_bytes": sum(p["logical_bytes"] for p in phases.values()),
+    }
+
+
+def reset() -> None:
+    with _lock:
+        _counts.clear()

@@ -45,12 +45,20 @@ from ..telemetry import trace as _ttrace
 
 _lock = threading.Lock()
 # phase -> [explicit_count, explicit_bytes, implicit_count, implicit_bytes,
-#           lane_pulls, stacked_count]
+#           lane_pulls, stacked_count, shard_pulls, sharded_count]
 # ``lane_pulls`` / ``stacked_count`` (round 11): a lane-stacked readback
 # moves L lanes' scalars in ONE blocking transfer; the stacked transfer
 # counts once in explicit_count (the budget currency) while lane_pulls
 # accumulates L (what the per-graph pipeline would have paid) — the census
 # quantifies the readbacks the lane stack amortized away.
+# ``shard_pulls`` / ``sharded_count`` (round 13): the mesh analog of the
+# lane pair — a readback from a P-shard SPMD computation fans P shards'
+# data into ONE blocking transfer (one host program, one gather), where a
+# per-rank MPI program would pay P separate device->host reads.  The
+# transfer still counts once (budget currency unchanged); shard_pulls
+# accumulates P so per-shard-level budgets can be expressed and the
+# amortization quantified (shard_pulls - sharded_count = transfers the
+# SPMD mesh design saved vs the per-rank layout).
 _counts: Dict[str, list] = {}
 _tls = threading.local()
 _budget_checks = False
@@ -87,12 +95,12 @@ def scoped(name: str):
 
 
 def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None,
-          lanes: int = 0) -> None:
+          lanes: int = 0, shards: int = 0) -> None:
     ph = phase or _phase()
     with _lock:
         row = _counts.get(ph)
         if row is None:
-            row = _counts[ph] = [0, 0, 0, 0, 0, 0]
+            row = _counts[ph] = [0, 0, 0, 0, 0, 0, 0, 0]
         row[kind_offset] += count
         row[kind_offset + 1] += nbytes
         if lanes > 0:
@@ -101,6 +109,11 @@ def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None,
             # stays consistent with the engine's lanestacked_batches.
             row[4] += lanes * count
             row[5] += count
+        if shards > 0:
+            # Mesh-wide pull: one transfer services all P shards (even
+            # P=1 — a single-shard mesh run stays comparable).
+            row[6] += shards * count
+            row[7] += count
         total_count = sum(r[0] for r in _counts.values())
         total_bytes = sum(r[1] for r in _counts.values())
         total_implicit = sum(r[2] for r in _counts.values())
@@ -116,7 +129,7 @@ def _bump(kind_offset: int, count: int, nbytes: int, phase: str | None = None,
         })
 
 
-def pull(*arrays, phase: str | None = None, lanes: int = 0):
+def pull(*arrays, phase: str | None = None, lanes: int = 0, shards: int = 0):
     """The sanctioned blocking device->host readback: materialize each array
     on the host, counting one blocking transfer (and its bytes) per array
     against the current phase.  Callers batch their per-level scalars into
@@ -128,6 +141,12 @@ def pull(*arrays, phase: str | None = None, lanes: int = 0):
     pulls the per-graph pipeline would have paid (``lane_pulls`` /
     ``stacked_count`` in :func:`snapshot`).
 
+    ``shards`` (round 13): mark a *mesh-wide* readback from a P-shard SPMD
+    computation — one transfer gathers every shard's slice, where a
+    per-rank program would pay P reads.  ``shard_pulls`` accumulates P per
+    transfer so :func:`assert_phase_budget` can express per-shard-level
+    budgets (pass ``shards=P`` there too).
+
     Returns a single ndarray for one input, else a tuple of ndarrays.
     """
     import jax
@@ -138,7 +157,7 @@ def pull(*arrays, phase: str | None = None, lanes: int = 0):
     with jax.transfer_guard_device_to_host("allow"):
         for a in arrays:
             host = np.asarray(a)
-            _bump(0, 1, int(host.nbytes), phase, lanes=lanes)
+            _bump(0, 1, int(host.nbytes), phase, lanes=lanes, shards=shards)
             out.append(host)
     return out[0] if len(out) == 1 else tuple(out)
 
@@ -167,10 +186,22 @@ def lane_phase_count(name: str) -> Tuple[int, int]:
         return (row[4], row[5])
 
 
+def shard_phase_count(name: str) -> Tuple[int, int]:
+    """(shard_pulls, sharded_count) of phase ``name`` — the per-shard
+    accounting pair of the dist/mesh tier (round 13)."""
+    with _lock:
+        row = _counts.get(name)
+        if row is None:
+            return (0, 0)
+        return (row[6], row[7])
+
+
 def snapshot() -> dict:
     """{phase: {count, bytes, implicit, implicit_bytes, lane_pulls,
-    stacked_count}} plus totals.  ``lane_pulls - stacked_count`` per phase =
-    blocking transfers the lane stack amortized away."""
+    stacked_count, shard_pulls, sharded_count}} plus totals.
+    ``lane_pulls - stacked_count`` per phase = blocking transfers the lane
+    stack amortized away; ``shard_pulls - sharded_count`` = transfers the
+    SPMD mesh saved vs a per-rank layout (round 13)."""
     with _lock:
         phases = {
             k: {
@@ -180,6 +211,8 @@ def snapshot() -> dict:
                 "implicit_bytes": v[3],
                 "lane_pulls": v[4],
                 "stacked_count": v[5],
+                "shard_pulls": v[6],
+                "sharded_count": v[7],
             }
             for k, v in sorted(_counts.items())
         }
@@ -190,6 +223,8 @@ def snapshot() -> dict:
         "implicit": sum(p["implicit"] for p in phases.values()),
         "lane_pulls": sum(p["lane_pulls"] for p in phases.values()),
         "stacked_count": sum(p["stacked_count"] for p in phases.values()),
+        "shard_pulls": sum(p["shard_pulls"] for p in phases.values()),
+        "sharded_count": sum(p["sharded_count"] for p in phases.values()),
     }
 
 
@@ -210,11 +245,41 @@ def budget_checks_enabled() -> bool:
     return _budget_checks
 
 
-def assert_phase_budget(name: str, budget: int, since: int = 0) -> None:
+def assert_phase_budget(name: str, budget: int, since: int = 0,
+                        shards: int = 0, count_since: int = 0) -> None:
     """Raise when phase ``name`` performed more than ``budget`` blocking
     transfers since the ``since`` snapshot (see :func:`phase_count`).
-    No-op unless :func:`enable_budget_checks` armed it."""
+    No-op unless :func:`enable_budget_checks` armed it.
+
+    With ``shards=P`` (round 13) the budget is expressed *per shard*: the
+    check runs in the per-shard currency — ``shard_pulls`` (see
+    :func:`shard_phase_count`; ``since`` is then a shard_pulls snapshot)
+    must stay within ``budget * P`` — AND in the plain transfer currency
+    (``count_since`` is the matching :func:`phase_count` snapshot), so a
+    stray pull that forgot its ``shards=`` tag still trips the budget
+    instead of hiding from the per-shard ledger.  A mesh-wide pull
+    services all P shards in one transfer, so both bounds coincide for
+    correctly tagged code; phrasing the budget per shard keeps dist
+    budgets comparable across mesh sizes and is the accounting ROADMAP
+    item 1's sharded pipeline extends."""
     if not _budget_checks:
+        return
+    if shards > 0:
+        used = shard_phase_count(name)[0] - since
+        allowed = budget * shards
+        if used > allowed:
+            raise AssertionError(
+                f"per-shard sync budget exceeded in phase {name!r}: "
+                f"{used} logical shard pulls > {budget} per shard x "
+                f"{shards} shards = {allowed} (see utils/sync_stats.py)"
+            )
+        used_count = phase_count(name) - count_since
+        if used_count > budget:
+            raise AssertionError(
+                f"sync budget exceeded in phase {name!r}: {used_count} "
+                f"blocking transfers > budget {budget} (includes pulls "
+                f"missing their shards= tag; see utils/sync_stats.py)"
+            )
         return
     used = phase_count(name) - since
     if used > budget:

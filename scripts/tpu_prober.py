@@ -258,8 +258,19 @@ def daemon_loop() -> None:
                 rec["git_head"] = _git_head()
             except Exception:  # noqa: BLE001
                 pass
+            rec["stale_vs_head"] = False  # captured at head, this round
             with open(RESULT_PATH, "w") as fh:
                 json.dump(rec, fh, indent=1)
+            # Run ledger (round 13): an on-silicon capture is exactly the
+            # entry the regression sentinel wants a window of.
+            try:
+                from kaminpar_tpu.telemetry import ledger
+
+                ledger.record_run(
+                    rec, kind="prober", git_head=rec.get("git_head", "")
+                )
+            except Exception:  # noqa: BLE001
+                pass
             _log({"event": "prober_success", "attempt": attempt})
             return
         if (

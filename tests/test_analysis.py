@@ -220,7 +220,8 @@ def test_mutation_gate_unpulled_asarray_in_dist_fails_lint():
     the lint gate fail — mutate the real dist/metrics.py back to the
     pre-fix spelling."""
     src = (REPO / "kaminpar_tpu/dist/metrics.py").read_text()
-    fixed = "return sync_stats.pull(bw, phase=\"dist_metrics\")"
+    fixed = ("return sync_stats.pull(bw, phase=\"dist_metrics\", "
+             "shards=graph.num_shards)")
     assert fixed in src
     analyzer = Analyzer(ALL_RULES, default_config())
     rel = "kaminpar_tpu/dist/metrics.py"

@@ -396,7 +396,9 @@ def test_shard_stats_aggregation():
     txt = st.render()
     assert "owned_edges" in txt and "imb" in txt
     mr = st.machine_readable()
-    assert mr.count("SHARDSTAT") == 4
+    # 4 per-row lines + the round-13 aggregate skew line
+    assert mr.count("SHARDSTAT ") == 4
+    assert mr.count("SHARDSTAT_SUMMARY") == 1
 
     # repeated record() accumulates (per-round phase counters)
     acc = ShardStats(2)

@@ -1,0 +1,236 @@
+"""Run ledger + regression sentinel (ISSUE 8): RUNS.jsonl append/read,
+noise-aware baseline comparison, the tools ledger/regress CLI contract
+(regress exits nonzero on an injected 2x phase regression and zero on an
+identical replay), and the bench salvage compression satellite."""
+
+import json
+
+import pytest
+
+from kaminpar_tpu.telemetry import ledger
+
+
+def _record(**overrides):
+    rec = {
+        "value": 2.5e6,
+        "vs_baseline": 0.003,
+        "backend": "cpu-fallback",
+        "partition_wall_s": 120.0,
+        "partition_cut": 60000,
+        "host_sync_count": 48,
+        "host_sync_bytes": 12345,
+        "phase_walls_s": {"partitioning": 110.0, "lp_bench_fence": 4.0},
+        "collectives": {"count": 30, "logical_bytes": 4096,
+                        "by_op": {"psum": {"count": 25, "logical_bytes": 1024},
+                                  "all_to_all": {"count": 5,
+                                                 "logical_bytes": 3072}}},
+        "compiled_shape_count": {"total": 40},
+        "lint": {"fresh": 0},
+    }
+    rec.update(overrides)
+    return rec
+
+
+def test_entry_build_append_read_roundtrip(tmp_path):
+    path = str(tmp_path / "RUNS.jsonl")
+    entry = ledger.build_entry(_record(), kind="bench", git_head="abc1234")
+    assert entry["schema"] == ledger.SCHEMA
+    assert entry["kind"] == "bench"
+    assert entry["git_head"] == "abc1234"
+    assert entry["backend"] == "cpu-fallback"
+    assert entry["metrics"]["partition_wall_s"] == 120.0
+    assert entry["metrics"]["partition_cut"] == 60000
+    assert entry["sync"]["count"] == 48
+    assert entry["collectives"]["count"] == 30
+    assert entry["collectives"]["by_op"] == {"psum": 25, "all_to_all": 5}
+    assert entry["compiled_shapes"] == 40
+    assert entry["stale_vs_head"] is False
+
+    ledger.append(entry, path)
+    ledger.append(ledger.build_entry(_record(), kind="bench"), path)
+    entries = ledger.read(path)
+    assert len(entries) == 2
+    assert entries[0]["git_head"] == "abc1234"
+    assert ledger.tail(1, path) == entries[-1:]
+
+    # a torn write must not poison the ledger
+    with open(path, "a") as fh:
+        fh.write('{"truncated": tru\n')
+    assert len(ledger.read(path)) == 2
+
+
+def test_metric_direction_classes():
+    assert ledger.metric_direction("partition_wall_s") == "down"
+    assert ledger.metric_direction("serve_p99_ms") == "down"
+    assert ledger.metric_direction("partition_cut") == "down"
+    assert ledger.metric_direction("host_sync_count") == "down"
+    assert ledger.metric_direction("value") == "up"
+    assert ledger.metric_direction("serve_throughput_gps") == "up"
+    assert ledger.metric_direction("lanestack_vs_pergraph") == "up"
+    assert ledger.metric_direction("vs_baseline") == "up"
+
+
+def test_compare_quiet_on_identical_and_within_noise():
+    base = [ledger.build_entry(_record(), kind="bench") for _ in range(3)]
+    # identical replay: silent
+    assert ledger.compare(ledger.build_entry(_record(), kind="bench"), base) == []
+    # within the noise tolerance: silent
+    near = ledger.build_entry(
+        _record(partition_wall_s=140.0,
+                phase_walls_s={"partitioning": 125.0}), kind="bench"
+    )
+    assert ledger.compare(near, base) == []
+
+
+def test_compare_flags_wall_census_quality_and_throughput():
+    base = [ledger.build_entry(_record(), kind="bench") for _ in range(3)]
+    bad = ledger.build_entry(
+        _record(
+            partition_wall_s=240.0,       # 2x wall
+            host_sync_count=49,           # one stray blocking transfer
+            partition_cut=70000,          # ~17% worse cut
+            value=1.0e6,                  # throughput collapse
+            phase_walls_s={"partitioning": 110.0, "lp_bench_fence": 4.0},
+            collectives={"count": 31, "logical_bytes": 4096, "by_op": {}},
+        ),
+        kind="bench",
+    )
+    regs = {r["metric"]: r for r in ledger.compare(bad, base)}
+    assert "partition_wall_s" in regs and regs["partition_wall_s"]["class"] == "wall"
+    assert "census.host_sync_count" in regs
+    assert regs["census.host_sync_count"]["class"] == "census"
+    assert "census.collective_count" in regs
+    assert "partition_cut" in regs and regs["partition_cut"]["class"] == "quality"
+    assert "value" in regs and regs["value"]["class"] == "throughput"
+
+
+def test_baseline_window_filters_kind_backend_and_workload():
+    entries = [
+        ledger.build_entry(_record(backend="cpu-fallback"), kind="bench"),
+        ledger.build_entry(_record(backend="tpu"), kind="bench"),
+        ledger.build_entry(_record(backend="cpu-fallback"), kind="prober"),
+        ledger.build_entry(_record(backend="cpu-fallback"), kind="bench"),
+        # same kind/backend but a DIFFERENT workload scale: not a baseline
+        ledger.build_entry(
+            _record(backend="cpu-fallback", partition_scale=9), kind="bench"
+        ),
+    ]
+    latest = ledger.build_entry(
+        _record(backend="cpu-fallback", partition_scale=17), kind="bench"
+    )
+    window = ledger.baseline_window(entries, latest, window=5)
+    # the two scale-free cpu-fallback bench entries match (absent config
+    # keys are compatible); the scale-9 entry does not
+    assert len(window) == 2
+    assert all(e["backend"] == "cpu-fallback" and e["kind"] == "bench"
+               for e in window)
+    assert all(
+        (e.get("metrics") or {}).get("partition_scale") is None
+        for e in window
+    )
+
+
+def test_tools_regress_cli_exit_codes(tmp_path, capsys):
+    """Acceptance (ISSUE 8): ``tools regress`` exits nonzero on a
+    synthetically injected 2x phase regression and zero on a replayed
+    identical entry."""
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    path = str(tmp_path / "RUNS.jsonl")
+    for _ in range(3):
+        ledger.append(ledger.build_entry(_record(), kind="bench"), path)
+    # identical replay
+    ledger.append(ledger.build_entry(_record(), kind="bench"), path)
+    assert tools_main(["regress", "--runs", path]) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+    # injected 2x regression in a phase wall
+    ledger.append(
+        ledger.build_entry(
+            _record(phase_walls_s={"partitioning": 220.0,
+                                   "lp_bench_fence": 4.0}),
+            kind="bench",
+        ),
+        path,
+    )
+    assert tools_main(["regress", "--runs", path]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION phase.partitioning_s" in out
+
+    # empty / no-baseline ledgers stay quiet (exit 0)
+    empty = str(tmp_path / "EMPTY.jsonl")
+    assert tools_main(["regress", "--runs", empty]) == 0
+    lone = str(tmp_path / "LONE.jsonl")
+    ledger.append(ledger.build_entry(_record(backend="tpu"), kind="bench"), lone)
+    assert tools_main(["regress", "--runs", lone]) == 0
+    capsys.readouterr()
+
+
+def test_tools_ledger_cli(tmp_path, capsys):
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    path = str(tmp_path / "RUNS.jsonl")
+    src = tmp_path / "record.json"
+    src.write_text(json.dumps(_record()))
+    assert tools_main(["ledger", "append", "--runs", path,
+                       "--from-json", str(src), "--kind", "bench"]) == 0
+    capsys.readouterr()
+    assert tools_main(["ledger", "show", "--runs", path]) == 0
+    out = capsys.readouterr().out
+    assert "bench" in out and "partition_wall_s=120.0" in out
+    assert tools_main(["ledger", "tail", "--runs", path, "-n", "1"]) == 0
+    tail_out = capsys.readouterr().out
+    assert json.loads(tail_out)["kind"] == "bench"
+    # missing --from-json is an error, empty ledger is not
+    assert tools_main(["ledger", "append", "--runs", path]) == 1
+    capsys.readouterr()
+    assert tools_main(["ledger", "show", "--runs",
+                       str(tmp_path / "NONE.jsonl")]) == 0
+    assert "no ledger entries" in capsys.readouterr().out
+
+
+def test_record_run_kill_switch(tmp_path, monkeypatch):
+    path = str(tmp_path / "RUNS.jsonl")
+    monkeypatch.setenv("KPTPU_LEDGER", "0")
+    assert ledger.record_run(_record(), kind="bench", path=path) is None
+    assert ledger.read(path) == []
+    monkeypatch.setenv("KPTPU_LEDGER", "1")
+    assert ledger.record_run(_record(), kind="bench", path=path) == path
+    assert len(ledger.read(path)) == 1
+
+
+# -- bench salvage compression (satellite) -----------------------------------
+
+
+def test_probe_telemetry_compresses_attempts(tmp_path, monkeypatch):
+    """The prober summary embeds OUTCOME COUNTS (plus the 6h failure-window
+    count the inline-probe decision needs) instead of the full per-attempt
+    list that dominated BENCH_r05's tail."""
+    import time as _time
+
+    import bench
+
+    log = tmp_path / "TPU_PROBE_LOG.jsonl"
+    now = _time.time()
+    rows = [{"event": "prober_start"}]
+    for i in range(40):
+        rows.append({"attempt": i + 1, "ts": now - 3600 * 10,
+                     "iso": "old", "outcome": "init_hang_killed_after_1200s"})
+    rows.append({"attempt": 41, "ts": now - 60, "iso": "new",
+                 "outcome": "init_hang_killed_after_1201s"})
+    rows.append({"attempt": 42, "ts": now - 30, "iso": "newer",
+                 "outcome": "ambient_is_cpu"})
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setattr(bench, "TPU_PROBE_LOG", str(log))
+
+    summary = bench.probe_telemetry()
+    assert summary["attempts"] == 42
+    assert summary["outcomes"]["init_hang_killed_after_1200s"] == 40
+    assert "attempt_records" not in summary  # the compression satellite
+    # only the two attempts inside the 6h window count as recent failures
+    assert summary["recent_failed_6h"] == 2
+    assert bench._recent_failures(summary) == 2
+    assert bench._recent_failures(None) == 0
+    assert summary["last_outcome"] == "ambient_is_cpu"
+    # the summary is fixed-size: growing the log 10x must not grow it
+    assert len(json.dumps(summary)) < 2000

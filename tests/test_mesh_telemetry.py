@@ -1,0 +1,297 @@
+"""Mesh-scale telemetry (ISSUE 8): per-shard sync accounting, the
+collective-traffic census, and merged multi-rank traces on the virtual
+8-device CPU mesh.
+
+The contracts under test:
+
+- the dist pipeline's per-shard sync budgets hold in-pipeline with
+  telemetry ARMED (armed probes add zero blocking transfers — asserted via
+  the unchanged ``assert_phase_budget(shards=P)`` checks AND an explicit
+  per-phase pull-count equality between armed and off runs);
+- the collective census counts match a **hand-counted** expectation for
+  one LP refinement round and one balancer round (the census is trace-time
+  accounting, so one traced round body has a fixed, structurally derivable
+  op count);
+- arming telemetry is bit-inert on the dist tier (same partition);
+- the merged trace validates as Chrome trace JSON and carries one lane per
+  shard whose span walls ``tools trace --shards`` summarizes.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kaminpar_tpu import telemetry
+from kaminpar_tpu.dist import distribute_graph
+from kaminpar_tpu.dist.partitioner import DKaMinPar
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.telemetry import trace as ttrace
+from kaminpar_tpu.utils import collective_stats, sync_stats
+
+
+def _mesh(num=8):
+    devs = jax.devices()
+    if len(devs) < num:
+        pytest.skip(f"need {num} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:num]), ("nodes",))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    ttrace.stop()
+    sync_stats.reset()
+    collective_stats.reset()
+    yield
+    ttrace.stop()
+    sync_stats.reset()
+    collective_stats.reset()
+    sync_stats.enable_budget_checks(False)
+
+
+def _dist_ctx(cl=40, seed=3):
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.contraction_limit = cl  # force a real dist hierarchy
+    ctx.seed = seed
+    return ctx
+
+
+# -- collective census --------------------------------------------------------
+
+
+def test_collective_census_matches_hand_count():
+    """Acceptance (ISSUE 8): census counts for ONE traced LP refinement
+    round and ONE traced balancer round equal the hand count of their round
+    bodies.  The census is trace-time accounting (utils/collective_stats),
+    so the expectation is structural, not statistical."""
+    import kaminpar_tpu.dist.lp as dlp
+    from kaminpar_tpu.dist.balancer import make_dist_balance_round
+    from kaminpar_tpu.dist.lp import shard_arrays
+
+    mesh = _mesh()
+    g = generators.grid2d_graph(16, 16)
+    dg = distribute_graph(g, mesh.size)
+    k = 4
+    part = jnp.asarray(
+        np.random.default_rng(0).integers(0, k, dg.N).astype(np.int32)
+    )
+    part, dgs = shard_arrays(mesh, dg, part)
+    cap = jnp.full(k, int(1.2 * g.total_node_weight / k) + 4, dtype=jnp.int32)
+
+    # Force a fresh trace: the factories are lru_cached and the census
+    # counts per TRACED program, so a previously traced round contributes
+    # nothing (by design — that is the zero-per-execution-cost property).
+    dlp.make_dist_lp_round.cache_clear()
+    make_dist_balance_round.cache_clear()
+
+    collective_stats.reset()
+    with sync_stats.scoped("dist_refinement"):
+        dlp.dist_lp_round(
+            mesh, jax.random.key(0), part, dgs, cap, num_labels=k
+        )
+    ops = collective_stats.phase_ops("dist_refinement")
+    # Hand count of _refine_round_body (external_only=False, 1 chunk):
+    #   ghost_exchange ............................ 1 all_to_all
+    #   _global_block_weights ..................... 1 psum
+    #   _probabilistic_commit demand .............. 1 psum
+    #   _overweight_rollback: overweight_fixable is
+    #     traced TWICE (loop init + while body), 2 psums each ... 4 psums
+    #   num_moved ................................. 1 psum
+    assert ops == {"all_to_all": 1, "psum": 7}, ops
+    # Logical bytes of the exchange: per-shard (P, cap_g) int32 operand
+    # times the P participating shards.
+    snap = collective_stats.snapshot()["phases"]["dist_refinement"]
+    P = mesh.size
+    assert snap["ops"]["all_to_all"]["logical_bytes"] == (
+        P * dgs.cap_g * 4 * P
+    )
+
+    collective_stats.reset()
+    fn = make_dist_balance_round(mesh, k=k)
+    with sync_stats.scoped("dist_refinement"):
+        fn(jax.random.key(1), part, dgs.node_w, dgs.edge_u, dgs.col_loc,
+           dgs.edge_w, cap, dgs.send_idx, dgs.recv_map)
+    ops = collective_stats.phase_ops("dist_refinement")
+    # Hand count of _balance_round_body:
+    #   ghost_exchange 1 all_to_all; block_w, cand_w, demand psums (3);
+    #   rollback fixable traced twice (4); new_bw + moved psums (2).
+    assert ops == {"all_to_all": 1, "psum": 9}, ops
+
+    # Re-executing the SAME compiled round adds nothing: the census is
+    # per-specialization, like the compiled-shape census.
+    before = collective_stats.snapshot()["count"]
+    fn(jax.random.key(2), part, dgs.node_w, dgs.edge_u, dgs.col_loc,
+       dgs.edge_w, cap, dgs.send_idx, dgs.recv_map)
+    assert collective_stats.snapshot()["count"] == before
+
+
+# -- mesh dryrun: armed budgets + merged trace + bit identity ----------------
+
+
+def test_mesh_dryrun_budgets_trace_and_probe_neutrality(tmp_path):
+    """Acceptance (ISSUE 8): the 8-device dryrun runs with telemetry ARMED
+    and per-shard budgets asserted in-pipeline, produces ONE merged Chrome
+    trace with a lane per shard, and arming changes neither the partition
+    nor any dist phase's blocking-transfer count."""
+    mesh = _mesh()
+    P = mesh.size
+    g = generators.rmat_graph(9, 8, seed=7)
+    out = tmp_path / "mesh_trace.json"
+
+    # Off run FIRST: same seed, telemetry disarmed.  Besides providing the
+    # bit-identity/neutrality reference, it traces every program of this
+    # configuration — so the armed run below can additionally prove that
+    # arming telemetry adds ZERO collectives (trace-time census delta 0).
+    sync_stats.reset()
+    part_off = DKaMinPar(mesh, _dist_ctx()).compute_partition(g, k=4)
+    off_phases = sync_stats.snapshot()["phases"]
+    coll_before = collective_stats.snapshot()["count"]
+
+    # Armed run: budgets + tripwire + telemetry, all at once — the probes
+    # must pass the SAME armed checks the bare pipeline passes.
+    sync_stats.reset()
+    sync_stats.enable_budget_checks(True)
+    try:
+        with telemetry.run(trace_out=str(out)) as rec:
+            with sync_stats.tripwire():
+                part_armed = DKaMinPar(mesh, _dist_ctx()).compute_partition(
+                    g, k=4
+                )
+    finally:
+        sync_stats.enable_budget_checks(False)
+    # Zero added collectives with telemetry armed (everything was already
+    # traced by the off run, so any delta would be telemetry's own).
+    assert collective_stats.snapshot()["count"] == coll_before
+    armed_phases = sync_stats.snapshot()["phases"]
+    dist_phases = [p for p in armed_phases if p.startswith("dist_")]
+    assert "dist_coarsening" in dist_phases  # the hierarchy actually formed
+    for phase in dist_phases:
+        assert armed_phases[phase]["implicit"] == 0, (phase, armed_phases)
+        # per-shard accounting engaged: mesh-wide pulls carry shards=P
+        if phase in ("dist_coarsening", "dist_refinement"):
+            row = armed_phases[phase]
+            assert row["shard_pulls"] == row["sharded_count"] * P
+
+    # Quality rows for both dist level kinds rode existing pulls.
+    kinds = {r["kind"] for r in rec.quality}
+    assert "dist_coarsening_level" in kinds
+    assert "dist_uncoarsening_level" in kinds
+
+    # One merged Chrome trace: validates, carries a lane per shard, and
+    # the shard lanes expose per-level spans.
+    obj = json.loads(out.read_text())
+    summary = telemetry.validate_chrome_trace(obj)
+    assert "dist_coarsening_level" in summary["span_names"]
+    lanes = {
+        (e.get("args") or {}).get("name")
+        for e in obj["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {f"shard{s}" for s in range(P)} <= lanes
+    rows = ttrace.shard_lane_summary(obj)
+    assert rows and all(len(r["walls_ms"]) == P for r in rows)
+    assert all(r["imb"] >= 1.0 for r in rows)
+
+    # Probe neutrality, PR 5 style: bit-identical partition and per-phase
+    # pull-count equality between the armed and off runs.
+    assert np.array_equal(part_armed, part_off)
+    for phase in dist_phases:
+        assert (
+            armed_phases[phase]["count"]
+            == off_phases.get(phase, {"count": 0})["count"]
+        ), (phase, armed_phases[phase], off_phases.get(phase))
+        assert (
+            armed_phases[phase]["shard_pulls"]
+            == off_phases.get(phase, {"shard_pulls": 0})["shard_pulls"]
+        )
+
+
+def test_tools_trace_shards_summary(tmp_path, capsys):
+    """``tools trace --shards`` prints the per-shard imbalance table from a
+    mesh trace's lane spans (and stays quiet on a non-mesh trace)."""
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    rec = ttrace.TraceRecorder()
+    rec.begin("dist_coarsening")
+    # Two shard lanes, 3:1 work skew across two levels.
+    for level, t0 in ((0, 0.0), (1, 1000.0)):
+        rec.lane_span("shard0", "dist_coarsening_level", t0, t0 + 900.0,
+                      level=level)
+        rec.lane_span("shard1", "dist_coarsening_level", t0, t0 + 300.0,
+                      level=level)
+    rec.end("dist_coarsening")
+    path = tmp_path / "t.json"
+    rec.write(str(path))
+
+    rows = ttrace.shard_lane_summary(json.loads(path.read_text()))
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["name"] == "dist_coarsening_level"
+    assert row["walls_ms"] == [1.8, 0.6]  # 2 x 900us / 2 x 300us
+    assert row["imb"] == pytest.approx(1.5)
+
+    assert tools_main(["trace", str(path), "--shards"]) == 0
+    out = capsys.readouterr().out
+    assert "imb 1.50" in out
+    assert "shard-lane walls over 2 shards" in out
+
+    # A trace without shard lanes reports none instead of failing.
+    rec2 = ttrace.TraceRecorder()
+    rec2.begin("partitioning")
+    rec2.end("partitioning")
+    path2 = tmp_path / "t2.json"
+    rec2.write(str(path2))
+    assert tools_main(["trace", str(path2), "--shards"]) == 0
+    assert "shard lanes: (none" in capsys.readouterr().out
+
+
+# -- shard work table ---------------------------------------------------------
+
+
+def test_shard_work_table_zero_pull_stats():
+    """distribute_graph populates the host-computed per-shard work table;
+    collect_graph_stats consumes it WITHOUT any device readback, and the
+    render/machine_readable outputs carry the skew summary column."""
+    from kaminpar_tpu.dist.shard_stats import collect_graph_stats
+
+    g = generators.rmat_graph(9, 8, seed=5)
+    dg = distribute_graph(g, 8)
+    assert len(dg.shard_work) == 8
+    assert sum(w["owned_nodes"] for w in dg.shard_work) == g.n
+    assert sum(w["owned_edges"] for w in dg.shard_work) == g.m
+    for w, gg in zip(dg.shard_work, dg.ghost_global):
+        assert w["ghost_nodes"] == len(gg)
+
+    sync_stats.reset()
+    st = collect_graph_stats(dg)
+    assert sync_stats.snapshot()["count"] == 0  # zero readbacks
+    assert st.stats("owned_nodes")["imb"] >= 1.0
+    agg = st.imbalance_summary()
+    assert agg["max_imb"] >= agg["mean_imb"] >= 1.0
+    assert agg["worst"] in ("owned_nodes", "owned_edges", "ghost_nodes",
+                            "interface_nodes")
+    assert "SHARDSTAT_SUMMARY" in st.machine_readable()
+    assert "imbalance" in st.render()
+
+
+def test_coarse_graph_carries_shard_work():
+    """The contraction assembly populates shard_work for coarse levels too
+    (from its own host-resident assembly arrays)."""
+    from kaminpar_tpu.dist.contraction import contract_dist_clustering
+    from kaminpar_tpu.dist.lp import shard_arrays
+
+    mesh = _mesh()
+    g = generators.rmat_graph(9, 8, seed=5)
+    dg = distribute_graph(g, mesh.size)
+    group = np.arange(dg.N, dtype=np.int32)
+    group[: g.n] = (np.arange(g.n) // 3 * 3).astype(np.int32)
+    labels, dgs = shard_arrays(mesh, dg, jnp.asarray(group))
+    coarse, _, n_c = contract_dist_clustering(mesh, dgs, labels)
+    assert len(coarse.shard_work) == mesh.size
+    assert sum(w["owned_nodes"] for w in coarse.shard_work) == n_c
+    assert sum(w["owned_edges"] for w in coarse.shard_work) == coarse.m

@@ -71,6 +71,45 @@ def test_assert_phase_budget():
     sync_stats.assert_phase_budget("budgeted", 0)  # disarmed: no-op
 
 
+def test_shard_pull_accounting_and_per_shard_budget():
+    """Round 13: a mesh-wide pull counts ONE blocking transfer (budget
+    currency unchanged) while shard_pulls records the P logical reads a
+    per-rank layout would have paid, and assert_phase_budget(shards=P)
+    expresses budgets in that per-shard currency."""
+    with sync_stats.scoped("meshy"):
+        sync_stats.pull(jnp.arange(8), shards=4)
+        sync_stats.pull(jnp.arange(8), jnp.arange(8), shards=4)
+    snap = sync_stats.snapshot()["phases"]["meshy"]
+    assert snap["count"] == 3           # one transfer per pulled array
+    assert snap["shard_pulls"] == 12    # x4 shards each
+    assert snap["sharded_count"] == 3
+    assert sync_stats.shard_phase_count("meshy") == (12, 3)
+    assert sync_stats.snapshot()["shard_pulls"] == 12
+
+    sync_stats.enable_budget_checks(True)
+    try:
+        sync_stats.assert_phase_budget("meshy", 3, shards=4)  # 12 <= 12
+        with pytest.raises(AssertionError, match="per-shard sync budget"):
+            sync_stats.assert_phase_budget("meshy", 2, shards=4)  # 12 > 8
+        # since= takes a shard_pulls snapshot in per-shard mode (and
+        # count_since= the matching plain-count snapshot)
+        since = sync_stats.shard_phase_count("meshy")[0]
+        count_since = sync_stats.phase_count("meshy")
+        with sync_stats.scoped("meshy"):
+            sync_stats.pull(jnp.arange(4), shards=4)
+        sync_stats.assert_phase_budget("meshy", 1, since=since, shards=4,
+                                       count_since=count_since)
+        # A stray pull that FORGOT its shards= tag is invisible to the
+        # per-shard ledger but must still trip the plain-currency bound.
+        with sync_stats.scoped("meshy"):
+            sync_stats.pull(jnp.arange(4))  # untagged stray
+        with pytest.raises(AssertionError, match="missing their shards"):
+            sync_stats.assert_phase_budget("meshy", 1, since=since, shards=4,
+                                           count_since=count_since)
+    finally:
+        sync_stats.enable_budget_checks(False)
+
+
 def _coarsen_all(graph, ctx, target_n=128):
     from kaminpar_tpu.coarsening.cluster_coarsener import ClusterCoarsener
 
