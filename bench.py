@@ -602,6 +602,119 @@ def run_serve_phase(record: dict | None = None) -> dict:
     return record
 
 
+def run_compress_phase(record: dict | None = None) -> dict:
+    """Phase 4 (ISSUE 10): compressed-graph device-pipeline A/B — the same
+    terapart run with ``device_decode`` off (host decompress + dense
+    kernels) vs ``finest`` (decode fused into the LP kernels), recording
+    wall per level (the run-trace quality rows ride the existing per-level
+    readbacks), resident bytes/edge of both adjacency tiers, the
+    compression ratio, and the HBM watermark delta.  Keys ride the
+    RUNS.jsonl ledger flat (``compress_ab_*``) so ``tools regress``
+    baseline windows cover them; tpu_prober carries the phase on-silicon
+    through run_benchmark."""
+    import jax
+    import numpy as np
+
+    from kaminpar_tpu.graph.compressed import compress
+    from kaminpar_tpu.graph.device_compressed import DeviceCompressedView
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.telemetry import trace as ttrace
+    from kaminpar_tpu.utils import RandomState, Timer, heap_profiler
+    from kaminpar_tpu.utils.heap_profiler import HeapProfiler
+
+    record = dict(record or {})
+    backend = jax.devices()[0].platform
+    k = int(os.environ.get("KPTPU_BENCH_K", 16))
+    # Scale 16 is the acceptance floor for the resident-bytes claim; warm
+    # CPU runs finish each arm in ~2 min (the full phase's scale-17 single
+    # run is the reference point).
+    scale = int(os.environ.get("KPTPU_BENCH_COMPRESS_SCALE", 16))
+    g = rmat_graph(scale, edge_factor=16, seed=1)
+    cg = compress(g)
+    cv = DeviceCompressedView(cg)
+    dense_bytes = cv.dense_resident_bytes()
+    comp_bytes = cv.resident_bytes()
+    ab: dict = {
+        "backend": backend,
+        "scale": scale,
+        "k": k,
+        "compression_ratio": round(cg.compression_ratio(), 3),
+        # Device-resident adjacency bytes of the finest level: what the
+        # dense path keeps in HBM between dispatches vs the compressed
+        # stream + decode metadata (graph/device_compressed.py).
+        "resident_bytes_dense": dense_bytes,
+        "resident_bytes_compressed": comp_bytes,
+        "bytes_per_edge_dense": round(dense_bytes / max(g.m, 1), 2),
+        "bytes_per_edge_compressed": round(comp_bytes / max(g.m, 1), 2),
+        "resident_reduction": round(dense_bytes / max(comp_bytes, 1), 3),
+    }
+    del cv  # the finest arm rebuilds its own; keep the A honest
+    # The env override beats the per-arm ctx knob (resolve_device_decode);
+    # a leftover KAMINPAR_TPU_DEVICE_DECODE would silently run both arms in
+    # the same mode and record a meaningless A/B into the ledger.
+    env_override = os.environ.pop("KAMINPAR_TPU_DEVICE_DECODE", None)
+    if env_override is not None:
+        ab["env_override_cleared"] = env_override
+    parts: dict = {}
+    for mode, tag in (("off", "dense"), ("finest", "decode")):
+        RandomState.reseed(0)
+        Timer.reset_global()
+        solver = KaMinPar("terapart")
+        solver.ctx.compression.device_decode = mode
+        trace_rec = None if ttrace.active() is not None else ttrace.start()
+        HeapProfiler.reset(enabled=True)
+        t0 = time.perf_counter()
+        try:
+            solver.set_graph(g)
+            parts[mode] = solver.compute_partition(k, epsilon=0.03)
+        finally:
+            wall = time.perf_counter() - t0
+            if trace_rec is not None:
+                ttrace.stop()
+        arm = {
+            "wall_s": round(wall, 2),
+            "coarsening_wall_s": _timer_phase_seconds(
+                "partitioning", "coarsening"
+            ),
+            # Allocator truth (empty on backends without stats — the
+            # honest CPU reading; the static resident_bytes_* above are
+            # exact either way).
+            "hbm": heap_profiler.watermark_report(),
+        }
+        if trace_rec is not None:
+            # Per-level rows (n, m, wall between level readbacks) — they
+            # rode the levels' existing single pulls, zero added transfers.
+            arm["levels"] = trace_rec.quality[:24]
+        ab[tag] = arm
+        HeapProfiler.reset(enabled=False)
+    if env_override is not None:
+        os.environ["KAMINPAR_TPU_DEVICE_DECODE"] = env_override
+    ab["identical_partition"] = bool(
+        np.array_equal(parts["off"], parts["finest"])
+    )
+    peaks = [
+        ab[tag].get("hbm", {}).get("peak_bytes_in_use")
+        for tag in ("dense", "decode")
+    ]
+    if all(isinstance(p, int) for p in peaks):
+        ab["hbm_peak_delta_bytes"] = peaks[0] - peaks[1]
+    record["compress_ab"] = ab
+    # Flat ledger keys (telemetry/ledger._numeric_metrics reads top-level
+    # numerics; *_ratio/*_reduction are higher-better, *_s/_bytes lower).
+    record.update({
+        "compress_ab_dense_wall_s": ab["dense"]["wall_s"],
+        "compress_ab_decode_wall_s": ab["decode"]["wall_s"],
+        "compress_ab_resident_bytes_dense": dense_bytes,
+        "compress_ab_resident_bytes_compressed": comp_bytes,
+        "compress_ab_compression_ratio": ab["compression_ratio"],
+        "compress_ab_resident_reduction": ab["resident_reduction"],
+        "compress_ab_identical": int(ab["identical_partition"]),
+    })
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def run_benchmark() -> dict:
     """All phases in-process (used by the prober child and --child mode).
     Returns the final headline record (the ledger entry's source)."""
@@ -610,6 +723,11 @@ def run_benchmark() -> dict:
         record = run_full_phase(record)
     if os.environ.get("KPTPU_BENCH_SERVE", "1") == "1":
         record = run_serve_phase(record)
+    if os.environ.get("KPTPU_BENCH_COMPRESS", "1") == "1":
+        try:
+            record = run_compress_phase(record)
+        except Exception as exc:  # noqa: BLE001 — A/B must not void phases 1-3
+            record["compress_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     return record
 
 
@@ -823,6 +941,8 @@ def main() -> None:
             run_full_phase()
         elif phase == "serve":
             run_serve_phase()
+        elif phase == "compress":
+            run_compress_phase()
         else:
             run_benchmark()
         return

@@ -30,9 +30,16 @@ class CoarseLevel:
 
 
 class ClusterCoarsener:
-    def __init__(self, ctx: Context, graph: CSRGraph):
+    def __init__(self, ctx: Context, graph: CSRGraph, compressed_view=None):
+        """``compressed_view`` (ISSUE 10, device_decode routing): a
+        DeviceCompressedView standing in for the finest CSR — level-0
+        clustering and contraction run straight off the compressed stream
+        (graph/device_compressed.py) and the dense finest graph is only
+        ever materialized by a device decode at final uncoarsening."""
         self.ctx = ctx
         self.input_graph = graph
+        self.input_cview = compressed_view
+        self.rematerializations = 0
         self.hierarchy: List[CoarseLevel] = []
         # Contraction count (levels attempted, including a final converged
         # attempt that is not pushed) — the denominator of the
@@ -64,6 +71,9 @@ class ClusterCoarsener:
         pinned = self.ctx.coarsening.lp.weighted_mode
         if pinned is not None:
             return bool(pinned)
+        if self.input_graph is None and self.input_cview is not None:
+            # compress() stores edge_w=None exactly when all weights are 1.
+            return self.input_cview._cg.edge_w is not None
         g = self.input_graph
         if g is None or g.m == 0:
             return False
@@ -80,10 +90,16 @@ class ClusterCoarsener:
         levels no array of size m is held — ``current_graph`` re-decodes
         from ``compressed`` only when uncoarsening reaches the finest level
         again (reference: compressed_graph.h:409 decodes in-kernel; here the
-        decode is per-*level*, which removes the same steady-state copy)."""
+        decode is per-*level*, which removes the same steady-state copy).
+        Under device_decode routing the re-materialization is a device
+        decode kernel off the retained compressed view (no host round
+        trip, zero blocking transfers) and the finest dense CSR never
+        existed in the first place."""
         if self.hierarchy:
             self._compressed = compressed
+            self._cview = self.input_cview
             self.input_graph = None
+            self.input_cview = None
             self.rematerializations = 0
 
     @property
@@ -91,13 +107,35 @@ class ClusterCoarsener:
         if self.hierarchy:
             return self.hierarchy[-1].graph
         if self.input_graph is None:
-            Logger.log(
-                "  terapart: re-materializing finest CSR from compressed",
-                OutputLevel.DEBUG,
-            )
+            cview = getattr(self, "_cview", None) or self.input_cview
             self.rematerializations += 1
-            self.input_graph = self._compressed.decompress()
+            if cview is not None:
+                Logger.log(
+                    "  terapart: device-decoding finest CSR from the "
+                    "compressed stream",
+                    OutputLevel.DEBUG,
+                )
+                with scoped_timer("compressed_decode"):
+                    self.input_graph = cview.materialize_csr()
+            else:
+                Logger.log(
+                    "  terapart: re-materializing finest CSR from compressed",
+                    OutputLevel.DEBUG,
+                )
+                self.input_graph = self._compressed.decompress()
         return self.input_graph
+
+    @property
+    def current_n(self) -> int:
+        """Node count of the current level WITHOUT materializing it (the
+        coarsening loop's termination check must not force a finest-level
+        decode when the input is a compressed view)."""
+        if self.hierarchy:
+            return self.hierarchy[-1].graph.n
+        if self.input_graph is not None:
+            return self.input_graph.n
+        cview = getattr(self, "_cview", None) or self.input_cview
+        return cview.n
 
     @property
     def current_communities(self):
@@ -116,9 +154,19 @@ class ClusterCoarsener:
         below threshold, reference abstract_cluster_coarsener convergence)."""
         if self.clusterer is None:
             return False
-        graph = self.current_graph
+        # Level 0 off a compressed view (device_decode routing): clustering
+        # and contraction decode in-kernel; the dense finest CSR is never
+        # materialized here.
+        cview = (
+            self.input_cview
+            if not self.hierarchy and self.input_graph is None
+            else None
+        )
+        graph = None if cview is not None else self.current_graph
+        src = cview if cview is not None else graph
+        n_cur, m_cur = src.n, src.m
         max_cw = compute_max_cluster_weight(
-            self.ctx.coarsening, graph.n, graph.total_node_weight, k, epsilon
+            self.ctx.coarsening, n_cur, src.total_node_weight, k, epsilon
         )
         # Bound the per-level shrink: synchronous LP on dense graphs piles
         # nodes into popular clusters up to the global cap within one level
@@ -130,11 +178,17 @@ class ClusterCoarsener:
         # which bounds the per-level shrink implicitly.)
         sf = self.ctx.coarsening.max_shrink_factor
         if sf > 0:
-            avg_w = graph.total_node_weight / max(graph.n, 1)
+            avg_w = src.total_node_weight / max(n_cur, 1)
             max_cw = min(max_cw, max(int(sf * avg_w), 1))
         with scoped_timer("coarsening"):
             comm = self.current_communities
-            if comm is not None:
+            if cview is not None:
+                # Community restriction never reaches the compressed path
+                # (device_decode_eligible gates it out — masking needs
+                # per-edge weights the stream does not carry).
+                clusterer = self.clusterer
+                labels = clusterer.compute_clustering(cview, max_cw)
+            elif comm is not None:
                 # Zero out cross-community edges for the *clustering* only:
                 # ratings must be > 0, so LP can never adopt a label across
                 # a community boundary.  Isolated/two-hop passes merge
@@ -181,12 +235,20 @@ class ClusterCoarsener:
             # array (ops/contraction.py stats layout).
             lp_moved = getattr(clusterer, "last_num_moved", None)
             self.contractions += 1
+            from functools import partial
+
+            if cview is not None:
+                from ..ops.contraction import contract_compressed
+
+                contract = partial(contract_compressed, cview)
+            else:
+                contract = partial(contract_clustering, graph)
             if lp_moved is not None:
-                coarse, coarse_of, (lp_moved,) = contract_clustering(
-                    graph, labels, extra_scalars=(lp_moved,)
+                coarse, coarse_of, (lp_moved,) = contract(
+                    labels, extra_scalars=(lp_moved,)
                 )
             else:
-                coarse, coarse_of = contract_clustering(graph, labels)
+                coarse, coarse_of = contract(labels)
             coarse_comm = None
             if comm is not None:
                 # Clusters never span communities, so any member's id works.
@@ -202,8 +264,8 @@ class ClusterCoarsener:
             # density_target * old_m/old_n * new_n); lazily skipped unless
             # the coarse graph overshoots by laziness_factor.
             target_m = min(
-                s_ctx.edge_target_factor * graph.m,
-                s_ctx.density_target_factor * graph.m / max(graph.n, 1) * coarse.n,
+                s_ctx.edge_target_factor * m_cur,
+                s_ctx.density_target_factor * m_cur / max(n_cur, 1) * coarse.n,
             )
             target_m = int(min(target_m, coarse.m))
             # target_m < 2 would delete every edge (sparsify's guard branch)
@@ -218,7 +280,7 @@ class ClusterCoarsener:
         from ..telemetry import probes
 
         probes.coarsening_level(
-            level=len(self.hierarchy), n=graph.n, m=graph.m,
+            level=len(self.hierarchy), n=n_cur, m=m_cur,
             n_c=coarse.n, m_c=coarse.m, max_cluster_weight=max_cw,
             # Cached values only (seeded by the contraction readback; a
             # sparsified graph may lack them) — a probe must never sync.
@@ -229,10 +291,10 @@ class ClusterCoarsener:
                 getattr(clusterer, "ctx", None), "num_iterations", None
             ),
         )
-        shrink = 1.0 - coarse.n / max(graph.n, 1)
+        shrink = 1.0 - coarse.n / max(n_cur, 1)
         Logger.log(
-            f"  coarsening level {len(self.hierarchy)}: n={graph.n} -> {coarse.n}, "
-            f"m={graph.m} -> {coarse.m} (max_cw={max_cw}"
+            f"  coarsening level {len(self.hierarchy)}: n={n_cur} -> {coarse.n}, "
+            f"m={m_cur} -> {coarse.m} (max_cw={max_cw}"
             + (f", lp_moved={lp_moved}" if lp_moved is not None else "")
             + ")",
             OutputLevel.DEBUG,
@@ -244,8 +306,11 @@ class ClusterCoarsener:
 
     def coarsen(self, k: int, epsilon: float, target_n: int) -> CSRGraph:
         """Coarsen until ``n <= target_n`` or convergence (reference:
-        deep_multilevel.cc:86-149 coarsening loop)."""
-        while self.current_graph.n > target_n:
+        deep_multilevel.cc:86-149 coarsening loop).  The loop condition uses
+        ``current_n`` so a compressed-view input is not force-decoded; the
+        returned coarsest graph is dense either way (0-level runs
+        materialize the finest via the device decode)."""
+        while self.current_n > target_n:
             if not self.coarsen_once(k, epsilon):
                 break
         return self.current_graph

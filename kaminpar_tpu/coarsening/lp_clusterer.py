@@ -80,10 +80,12 @@ class LPClustering:
 
         return select_lp_ops(self.ctx.lp_kernel)[0]
 
-    def compute_clustering(self, graph: CSRGraph, max_cluster_weight: int):
-        """Returns padded labels (over graph.padded()); pad nodes carry the
-        anchor label.  Fully device-resident: no blocking readback happens
-        here — the per-clustering moved count stays on device as
+    def compute_clustering(self, graph, max_cluster_weight: int):
+        """Returns padded labels (over graph.padded(), or the equal-shape
+        label space of a :class:`~kaminpar_tpu.graph.device_compressed.
+        DeviceCompressedView` — the two share ``n_pad``); pad nodes carry
+        the anchor label.  Fully device-resident: no blocking readback
+        happens here — the per-clustering moved count stays on device as
         ``self.last_num_moved`` so the coarsener can batch it into the
         level's single readback."""
         with scoped_timer("lp_clustering", sync=True) as ts:
@@ -97,7 +99,11 @@ class LPClustering:
             ts.note(labels)
         return labels
 
-    def _one_clustering(self, graph: CSRGraph, max_cluster_weight: int):
+    def _one_clustering(self, graph, max_cluster_weight: int):
+        from ..graph.device_compressed import DeviceCompressedView
+
+        if isinstance(graph, DeviceCompressedView):
+            return self._one_clustering_compressed(graph, max_cluster_weight)
         pv = graph.padded()
         bv = graph.bucketed()
         n_pad = pv.n_pad
@@ -170,5 +176,74 @@ class LPClustering:
             )
         # Device scalar — NOT pulled here; the coarsener packs it into the
         # level's single batched readback (contract_clustering).
+        self.last_num_moved = state.num_moved
+        return state.labels
+
+    def _one_clustering_compressed(self, cv, max_cluster_weight: int):
+        """The clustering sweep off the device-resident compressed stream
+        (ISSUE 10 tentpole): the same label space (``n_pad`` matches the
+        dense PaddedView), the same key-draw order (one iterate key, one
+        two-hop key), and the decode-fused round kernels — bit-identical
+        labels to the dense sweep on the decompressed graph (asserted in
+        tests/test_device_compressed.py)."""
+        n_pad = cv.n_pad
+        idt = cv.node_w_pad.dtype
+        labels = jnp.concatenate(
+            [
+                jnp.arange(cv.n, dtype=idt),
+                jnp.full(n_pad - cv.n, cv.anchor, dtype=idt),
+            ]
+        )
+        state = lp.init_state(labels, cv.node_w_pad, n_pad)
+        max_w = jnp.asarray(int(max_cluster_weight), dtype=idt)
+
+        iters = self.ctx.num_iterations
+        active_prob = self.ctx.active_prob
+        if self.weighted_graph:
+            # Same weighted-graph emulation as the dense branch (see
+            # _one_clustering) — the mode is pinned from the input graph,
+            # so both paths take the same parameters.
+            active_prob = min(active_prob, self.ctx.weighted_active_prob)
+            iters *= max(self.ctx.weighted_sweep_factor, 1)
+        elif (
+            cv.n > 0 and cv.m / cv.n < self.ctx.low_degree_boost_threshold
+        ):
+            iters *= max(self.ctx.low_degree_boost_factor, 1)
+        from ..ops.pallas_lp import select_compressed_iterate
+
+        iterate = select_compressed_iterate(self.ctx.lp_kernel)
+        state = iterate(
+            state,
+            next_key(),
+            cv.buckets,
+            cv.stream,
+            cv.heavy,
+            cv.gather_idx,
+            cv.node_w_pad,
+            max_w,
+            jnp.int32(int(self.ctx.min_moved_fraction * cv.n)),
+            jnp.int32(iters),
+            num_labels=n_pad,
+            active_prob=active_prob,
+            tie_break=self.ctx.tie_breaking.value,
+        )
+
+        if self.ctx.cluster_isolated_nodes:
+            state = lp.cluster_isolated_nodes(
+                state, cv.row_ptr_like(), cv.node_w_pad, max_w,
+                num_labels=n_pad,
+            )
+        if self.ctx.cluster_two_hop_nodes:
+            state = lp.cluster_two_hop_nodes_compressed(
+                state,
+                next_key(),
+                cv.buckets,
+                cv.stream,
+                cv.heavy,
+                cv.gather_idx,
+                cv.node_w_pad,
+                max_w,
+                num_labels=n_pad,
+            )
         self.last_num_moved = state.num_moved
         return state.labels

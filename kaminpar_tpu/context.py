@@ -723,6 +723,19 @@ class GraphCompressionContext:
     analog)."""
 
     enabled: bool = False
+    # Device-decode routing of the compressed stream (ISSUE 10 tentpole;
+    # graph/device_compressed.py):
+    # - "off": the storage tier only — the DEEP pipeline decompresses the
+    #   finest CSR on host before device work (the pre-round-14 behavior).
+    # - "finest": the finest level runs directly off the device-resident
+    #   compressed stream — clustering + contraction + the final LP
+    #   refinement pass decode in-kernel, and the finest re-materialization
+    #   at uncoarsening is a device decode kernel.  Bit-identical to the
+    #   dense path (asserted); warns + falls back dense outside the
+    #   envelope (64-bit build, HEM clustering, v-cycle communities).
+    # - "auto": like "finest" but falls back silently.
+    # KAMINPAR_TPU_DEVICE_DECODE overrides.
+    device_decode: str = "off"
 
 
 @dataclass

@@ -121,6 +121,41 @@ def host_deg_histogram(row_ptr: np.ndarray, n: int) -> np.ndarray:
     ).astype(np.int64)
 
 
+def node_width_plan(
+    deg: np.ndarray,
+    *,
+    min_width: int = MIN_WIDTH,
+    max_width: int = MAX_WIDTH,
+    min_rows: int = MIN_ROWS,
+):
+    """(per-node bucket width, heavy mask) — the host-side bucket plan.
+
+    Per-node width = next power of two >= degree, clamped; then sparse
+    width classes merge upward so small graphs use few kernel shapes.  An
+    undersized class merges into the next *naturally occupied* class, so
+    the cascade ends at next_pow2(max degree) — never at max_width — and a
+    coarse graph cannot be inflated past its own degree range.
+
+    Shared by :func:`build_bucketed_view` and the compressed layout builder
+    (graph/device_compressed.py), whose bit-identity contract requires the
+    two plans to be the SAME function — do not fork this logic.
+    """
+    deg = np.asarray(deg, dtype=np.int64)
+    width = np.maximum(
+        min_width, 2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64)
+    )
+    heavy_mask = deg > max_width
+    width = np.minimum(width, max_width)
+    natural = set(int(x) for x in np.unique(width[~heavy_mask]))
+    for w in sorted(natural)[:-1]:
+        sel = (~heavy_mask) & (width == w)
+        cnt = int(sel.sum())
+        if 0 < cnt < min_rows:
+            bigger = min(x for x in natural if x > w)
+            width[sel] = bigger
+    return width, heavy_mask
+
+
 def build_bucketed_view(
     row_ptr: np.ndarray,
     col_idx: np.ndarray,
@@ -139,22 +174,9 @@ def build_bucketed_view(
     m = col.shape[0]
     deg = np.diff(rp[: n + 1]).astype(np.int64)
 
-    # Per-node bucket width: next power of two >= degree, clamped.
-    width = np.maximum(min_width, 2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
-    heavy_mask = deg > max_width
-    width = np.minimum(width, max_width)
-
-    # Merge sparse width classes upward so small graphs use few kernel shapes.
-    # An undersized class merges into the next *naturally occupied* class, so
-    # the cascade ends at next_pow2(max degree) — never at max_width — and a
-    # coarse graph cannot be inflated past its own degree range.
-    natural = set(int(x) for x in np.unique(width[~heavy_mask]))
-    for w in sorted(natural)[:-1]:
-        sel = (~heavy_mask) & (width == w)
-        cnt = int(sel.sum())
-        if 0 < cnt < min_rows:
-            bigger = min(x for x in natural if x > w)
-            width[sel] = bigger
+    width, heavy_mask = node_width_plan(
+        deg, min_width=min_width, max_width=max_width, min_rows=min_rows
+    )
 
     buckets = []
     offsets = np.zeros(n, dtype=np.int64)

@@ -60,9 +60,12 @@ class KaMinPar:
         """Accepts a CSRGraph or a CompressedGraph (reference: the facade's
         Graph variant over CSR/compressed, kaminpar.h).  With
         ``ctx.compression.enabled`` (terapart presets) a CSR input is
-        stored compressed and decoded on demand — the storage tier of the
-        TeraPart analog; kernel-level on-the-fly decoding is a documented
-        future step (graph/compressed.py)."""
+        stored compressed — the TeraPart storage tier; with
+        ``ctx.compression.device_decode`` routed on (the terapart presets'
+        default) the finest level additionally runs straight off the
+        device-resident compressed stream with the decode fused into the
+        LP kernels (graph/device_compressed.py), bit-identical to the
+        dense path."""
         from .graph.compressed import CompressedGraph, compress
 
         # A weighted-mode pin auto-detected from a previous graph must not
@@ -91,9 +94,11 @@ class KaMinPar:
                 f"compressed input: {self.compressed_graph.memory_bytes()} B "
                 f"({self.compressed_graph.compression_ratio():.2f}x)",
             )
-            # Steady-state memory = the compressed copy only; the CSR form
-            # exists transiently inside compute_partition (kernel-level
-            # on-the-fly decoding is the next step, HBM_BUDGET.md).
+            # Steady-state memory = the compressed copy only; under
+            # device_decode routing the finest CSR never materializes at
+            # all (the LP kernels decode the stream in-kernel), otherwise
+            # it exists transiently inside compute_partition
+            # (HBM_BUDGET.md round 14).
             graph = None
         else:
             self.compressed_graph = None

@@ -57,8 +57,7 @@ def _edge_sort_perm(ku, kv, sentinel: int):
     return jnp.lexsort((kv, ku))
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
+def _contract_core(labels, edge_u, col_idx, edge_w, node_w):
     from ..utils import compile_stats
 
     compile_stats.record("contraction", arrays=[labels, col_idx])
@@ -138,6 +137,25 @@ def _contract_device(labels, edge_u, col_idx, edge_w, node_w):
     return coarse_of, stats, c_node_w, out_u, out_v, out_w, row_ptr
 
 
+_contract_device = partial(jax.jit, donate_argnums=(0,))(_contract_core)
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("m_pad",))
+def _contract_compressed_device(labels, stream, wstart, width, deg, node_w, *,
+                                m_pad: int):
+    """Contraction straight off the compressed stream: the flat decode
+    (graph/device_compressed.decode_flat_padded) feeds the contraction
+    sort-reduce *inside one fused program*, so the decoded edge arrays are
+    XLA transients of this dispatch — no resident dense CSR exists at the
+    finest level.  ``m_pad`` is the same geometric bucket the dense
+    PaddedView would use, so the contraction kernel shape (and the coarse
+    graph, bit for bit) matches the dense path."""
+    from ..graph.device_compressed import decode_flat_padded
+
+    _, col, ew, eu = decode_flat_padded(stream, wstart, width, deg, m_pad=m_pad)
+    return _contract_core(labels, eu, col, ew, node_w)
+
+
 @partial(jax.jit, static_argnames=("n_pad", "m_pad"))
 def _extract_padded(row_ptr, c_node_w, out_u, out_v, out_w, n_c, m_c, *,
                     n_pad: int, m_pad: int):
@@ -198,9 +216,34 @@ def contract_clustering(
     ``edge_u`` pre-seeded, so no later property access re-syncs the level.
     """
     pv = graph.padded()
-    coarse_of, stats, c_node_w, out_u, out_v, out_w, row_ptr = _contract_device(
+    outs = _contract_device(
         jnp.asarray(labels_padded), pv.edge_u, pv.col_idx, pv.edge_w, pv.node_w
     )
+    return _finish_contraction(
+        outs, n_fine=graph.n, m_fine=graph.m, layout_mode=graph._layout_mode,
+        total_node_weight=graph._total_node_weight, extra_scalars=extra_scalars,
+    )
+
+
+def contract_compressed(cview, labels_padded, *, extra_scalars=()):
+    """contract_clustering off a DeviceCompressedView: identical result,
+    identical one-readback contract, but the fine adjacency is decoded
+    in-trace (see _contract_compressed_device) instead of read from a
+    resident PaddedView."""
+    outs = _contract_compressed_device(
+        jnp.asarray(labels_padded), cview.stream, cview.wstart_pad,
+        cview.width_pad, cview.degree_pad, cview.node_w_pad,
+        m_pad=cview.m_pad,
+    )
+    return _finish_contraction(
+        outs, n_fine=cview.n, m_fine=cview.m, layout_mode=cview.layout_mode,
+        total_node_weight=cview.total_node_weight, extra_scalars=extra_scalars,
+    )
+
+
+def _finish_contraction(outs, *, n_fine: int, m_fine: int, layout_mode,
+                        total_node_weight, extra_scalars=()):
+    coarse_of, stats, c_node_w, out_u, out_v, out_w, row_ptr = outs
     if extra_scalars:
         idt = stats.dtype
         stats = jnp.concatenate(
@@ -228,10 +271,10 @@ def contract_clustering(
     from ..utils import compile_stats
 
     compile_stats.record("padded_bucket", statics=(n_pad, m_pad))
-    coarse._layout_mode = graph._layout_mode
-    if graph._total_node_weight is not None:
+    coarse._layout_mode = layout_mode
+    if total_node_weight is not None:
         # Contraction conserves total node weight (pads are weight-0).
-        coarse._total_node_weight = graph._total_node_weight
+        coarse._total_node_weight = total_node_weight
     coarse._max_node_weight = int(stats_np[2])
     coarse._total_edge_weight = int(stats_np[3])
     coarse._deg_hist = stats_np[4:STATS_LEN].astype(int)
@@ -241,11 +284,11 @@ def contract_clustering(
     from ..telemetry import probes
 
     probes.contraction_level(
-        n=graph.n, m=graph.m, n_c=n_c, m_c=m_c,
+        n=n_fine, m=m_fine, n_c=n_c, m_c=m_c,
         max_node_weight=coarse._max_node_weight,
         total_edge_weight=coarse._total_edge_weight,
     )
-    out = (coarse, coarse_of[: graph.n])
+    out = (coarse, coarse_of[:n_fine])
     if extra_scalars:
         return out + (tuple(int(x) for x in stats_np[STATS_LEN:]),)
     return out

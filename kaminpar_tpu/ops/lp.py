@@ -529,6 +529,188 @@ def lp_iterate_bucketed(
     return state
 
 
+# ---------------------------------------------------------------------------
+# Decode-fused LP over the compressed word stream (TeraPart compute tier).
+#
+# The XLA oracle twin of the fused Pallas compressed kernels
+# (ops/pallas_lp.py): each bucket's (R, w) neighbor matrix is materialized
+# *in-trace* from the packed gap stream (graph/device_compressed.decode_rows
+# — one two-word gather + shift/mask per edge, a row cumsum for the prefix)
+# and then rated by the exact dense per-bucket kernel, so no decoded m-sized
+# array is ever resident between dispatches and the results are bit-identical
+# to the dense bucketed path by construction (asserted in
+# tests/test_device_compressed.py).  Heavy rows stay dense (rare; the flat
+# edge-parallel path, mirroring the reference's two-phase LP split).
+# ---------------------------------------------------------------------------
+
+
+def compressed_best_moves(
+    key,
+    labels,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    label_weights,
+    max_label_weights,
+    *,
+    external_only: bool = True,
+    respect_caps: bool = True,
+    tie_break: str = "uniform",
+):
+    """bucketed_best_moves over the compressed layout — identical key
+    schedule (per-bucket fold_in, heavy at index len(cbuckets)), identical
+    rating math (the decoded Bucket feeds the same _bucket_moves)."""
+    from ..graph.bucketed import Bucket
+    from ..graph.device_compressed import decode_bucket
+    from .bucketed_gains import _bucket_moves, _heavy_moves, assemble_moves
+
+    n = gather_idx.shape[0]
+    n_pad = labels.shape[0]
+    outs = []
+    for i, cb in enumerate(cbuckets):
+        cols, wgts = decode_bucket(stream, cb, jnp.asarray(node_w).dtype)
+        outs.append(
+            _bucket_moves(
+                jax.random.fold_in(key, i), labels,
+                Bucket(cb.nodes, cols, wgts), node_w, label_weights,
+                max_label_weights, external_only=external_only,
+                respect_caps=respect_caps, tie_break=tie_break,
+            )
+        )
+    if heavy.nodes.shape[0] > 0:
+        outs.append(
+            _heavy_moves(
+                jax.random.fold_in(key, len(cbuckets)), labels, heavy,
+                node_w, label_weights, max_label_weights,
+                external_only=external_only, respect_caps=respect_caps,
+                tie_break=tie_break,
+            )
+        )
+    return assemble_moves(outs, gather_idx, labels, n, n_pad)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+)
+def lp_round_compressed(
+    state: LPState,
+    key,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
+) -> LPState:
+    """One LP round off the compressed stream; bit-identical to
+    lp_round_bucketed on the decompressed graph (same split/fold schedule,
+    same commit)."""
+    kr, kp = jax.random.split(key)
+    target, tconn, own_conn, _ = compressed_best_moves(
+        kr, state.labels, cbuckets, stream, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=True, tie_break=tie_break,
+    )
+    return _commit_moves(
+        state, kp, target, tconn, own_conn, node_w, max_label_weights,
+        num_labels, active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+    donate_argnums=(0,),
+)
+def lp_iterate_compressed(
+    state: LPState,
+    key,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    min_moved,
+    max_iterations,
+    *,
+    num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
+) -> LPState:
+    """lp_iterate_bucketed off the compressed stream: the same fused
+    on-device while loop (one dispatch per clustering, donated state, the
+    early-exit condition on device), with the per-round decode living
+    inside the loop body — the finest level's HBM never holds a decoded
+    neighbor array between rounds."""
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "lp_iterate_compressed",
+        arrays=[node_w, stream.words, *(b.nodes for b in cbuckets), heavy.cols],
+        statics=(
+            "xla", num_labels, active_prob, allow_tie_moves, tie_break,
+            jnp.asarray(max_label_weights).ndim,
+        ),
+    )
+    max_iterations = jnp.asarray(max_iterations, dtype=jnp.int32)
+
+    def cond(carry):
+        i, st = carry
+        return (i < max_iterations) & (st.num_moved > min_moved)
+
+    def body(carry):
+        i, st = carry
+        st = lp_round_compressed(
+            st, jax.random.fold_in(key, i), cbuckets, stream, heavy,
+            gather_idx, node_w, max_label_weights, num_labels=num_labels,
+            active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+            tie_break=tie_break,
+        )
+        return i + 1, st
+
+    state = state._replace(num_moved=jnp.int32(jnp.iinfo(jnp.int32).max))
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def cluster_two_hop_nodes_compressed(
+    state: LPState,
+    key,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+) -> LPState:
+    """Two-hop clustering with the favored-cluster pass decoded in-trace
+    from the compressed stream (the dense twin is
+    cluster_two_hop_nodes_bucketed; same key split, same match)."""
+    kr, kp = jax.random.split(key)
+    favored, fconn, _, _ = compressed_best_moves(
+        kr, state.labels, cbuckets, stream, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=False,
+    )
+    return two_hop_match(
+        state, kp, favored, fconn, node_w, max_label_weights,
+        num_labels=num_labels,
+    )
+
+
 @partial(jax.jit, static_argnames=("num_labels",))
 def cluster_isolated_nodes(
     state: LPState,

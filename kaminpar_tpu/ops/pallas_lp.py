@@ -143,57 +143,71 @@ def _bitonic_sort_rows(L, W):
 # --------------------------------------------------------------------------
 
 
+def _rate_rows_body(labels, node_w_tab, lw_tab, maxw_ref, nodes, cols, W, tie,
+                    *, external_only: bool, respect_caps: bool,
+                    tie_break: str, maxw_scalar: bool):
+    """The shared in-VMEM rating math of the dense and decode-fused rate
+    kernels: gather neighbor labels, bitonic row sort, run reduction,
+    cap/tie filtering.  Factoring it keeps the compressed kernel
+    byte-compatible with the dense one past the decode."""
+    own = labels[nodes]
+    nw = node_w_tab[nodes]
+    L = labels[cols]  # fused gather 1: neighbor labels
+    own_conn = jnp.sum(jnp.where(L == own[:, None], W, 0), axis=1)
+
+    Ls, Ws = _bitonic_sort_rows(L, W)
+    R = Ls.shape[0]
+    c = jnp.cumsum(Ws, axis=1)
+    change = Ls[:, 1:] != Ls[:, :-1]
+    start = jnp.concatenate([jnp.ones((R, 1), bool), change], axis=1)
+    end = jnp.concatenate([change, jnp.ones((R, 1), bool)], axis=1)
+    # Run rating at run ends: cumsum minus the run's base, propagated by
+    # a row cummax (monotone — weights are non-negative).
+    base = jnp.where(start, c - Ws, 0)
+    run_base = jax.lax.cummax(base, axis=1)
+    rating = c - run_base
+
+    is_cur = Ls == own[:, None]
+    ok = end & (rating > 0)
+    if external_only:
+        ok = ok & ~is_cur
+    lw_s = None
+    if respect_caps or tie_break == "lightest":
+        lw_s = lw_tab[Ls]  # fused gather 2: cluster weights
+    if respect_caps:
+        cap = maxw_ref[0] if maxw_scalar else maxw_ref[...][Ls]
+        fits = lw_s + nw[:, None] <= cap
+        ok = ok & fits if external_only else ok & (is_cur | fits)
+
+    score = jnp.where(ok, rating, -1)
+    best = jnp.max(score, axis=1)
+    has = best >= 0
+    eligible = ok & (rating == best[:, None]) & has[:, None]
+    if tie_break == "lightest":
+        lw_m = jnp.where(eligible, lw_s, jnp.iinfo(lw_s.dtype).max)
+        eligible = eligible & (lw_m == jnp.min(lw_m, axis=1)[:, None])
+    tie_m = jnp.where(eligible, tie, -1)
+    slot = jnp.argmax(tie_m, axis=1)
+    target = jnp.where(
+        has, jnp.take_along_axis(Ls, slot[:, None], axis=1)[:, 0], own
+    )
+    tconn = jnp.where(has, best, 0)
+    return target, tconn, own_conn, has
+
+
 def _make_rate_kernel(external_only: bool, respect_caps: bool, tie_break: str,
                       maxw_scalar: bool):
     def kernel(labels_ref, node_w_ref, lw_ref, maxw_ref,
                nodes_ref, cols_ref, wgts_ref, tie_ref,
                target_ref, tconn_ref, own_ref, has_ref):
-        labels = labels_ref[...]
-        nodes = nodes_ref[...]
-        own = labels[nodes]
-        nw = node_w_ref[...][nodes]
-        cols = cols_ref[...]
-        W = wgts_ref[...]
-        L = labels[cols]  # fused gather 1: neighbor labels
-        own_conn = jnp.sum(jnp.where(L == own[:, None], W, 0), axis=1)
-
-        Ls, Ws = _bitonic_sort_rows(L, W)
-        R = Ls.shape[0]
-        c = jnp.cumsum(Ws, axis=1)
-        change = Ls[:, 1:] != Ls[:, :-1]
-        start = jnp.concatenate([jnp.ones((R, 1), bool), change], axis=1)
-        end = jnp.concatenate([change, jnp.ones((R, 1), bool)], axis=1)
-        # Run rating at run ends: cumsum minus the run's base, propagated by
-        # a row cummax (monotone — weights are non-negative).
-        base = jnp.where(start, c - Ws, 0)
-        run_base = jax.lax.cummax(base, axis=1)
-        rating = c - run_base
-
-        is_cur = Ls == own[:, None]
-        ok = end & (rating > 0)
-        if external_only:
-            ok = ok & ~is_cur
-        lw_s = None
-        if respect_caps or tie_break == "lightest":
-            lw_s = lw_ref[...][Ls]  # fused gather 2: cluster weights
-        if respect_caps:
-            cap = maxw_ref[0] if maxw_scalar else maxw_ref[...][Ls]
-            fits = lw_s + nw[:, None] <= cap
-            ok = ok & fits if external_only else ok & (is_cur | fits)
-
-        score = jnp.where(ok, rating, -1)
-        best = jnp.max(score, axis=1)
-        has = best >= 0
-        eligible = ok & (rating == best[:, None]) & has[:, None]
-        if tie_break == "lightest":
-            lw_m = jnp.where(eligible, lw_s, jnp.iinfo(lw_s.dtype).max)
-            eligible = eligible & (lw_m == jnp.min(lw_m, axis=1)[:, None])
-        tie_m = jnp.where(eligible, tie_ref[...], -1)
-        slot = jnp.argmax(tie_m, axis=1)
-        target_ref[...] = jnp.where(
-            has, jnp.take_along_axis(Ls, slot[:, None], axis=1)[:, 0], own
+        target, tconn, own_conn, has = _rate_rows_body(
+            labels_ref[...], node_w_ref[...], lw_ref[...], maxw_ref,
+            nodes_ref[...], cols_ref[...], wgts_ref[...], tie_ref[...],
+            external_only=external_only, respect_caps=respect_caps,
+            tie_break=tie_break, maxw_scalar=maxw_scalar,
         )
-        tconn_ref[...] = jnp.where(has, best, 0)
+        target_ref[...] = target
+        tconn_ref[...] = tconn
         own_ref[...] = own_conn
         has_ref[...] = has
 
@@ -282,6 +296,230 @@ def pallas_best_moves(
             )
         )
     return assemble_moves(outs, gather_idx, labels, n, n_pad)
+
+
+# --------------------------------------------------------------------------
+# Kernel 1b: decode-fused gather + rate off the compressed word stream
+# (TeraPart compute tier).  Identical rating body as the dense kernel; the
+# (R, w) neighbor matrix is materialized in VMEM from the packed gap stream
+# — one gather of two consecutive words + shift/mask per edge + a row
+# cumsum (graph/device_compressed.decode_rows; the encoding was designed so
+# there is no data-dependent control flow).  The words table is VMEM-
+# resident beside the label/weight tables, so a round streams the
+# *compressed* bytes from HBM instead of the dense cols+wgts matrices.
+# --------------------------------------------------------------------------
+
+
+def _make_compressed_rate_kernel(w: int, external_only: bool,
+                                 respect_caps: bool, tie_break: str,
+                                 maxw_scalar: bool):
+    from ..graph.device_compressed import CompressedStream, decode_rows
+
+    def kernel(labels_ref, node_w_ref, lw_ref, maxw_ref, words_ref, ew_ref,
+               nodes_ref, ws_ref, wd_ref, dg_ref, es_ref, tie_ref,
+               target_ref, tconn_ref, own_ref, has_ref):
+        node_w_tab = node_w_ref[...]
+        nodes = nodes_ref[...]
+        cols, W = decode_rows(
+            CompressedStream(words_ref[...], ew_ref[...]), nodes,
+            ws_ref[...], wd_ref[...], dg_ref[...], es_ref[...],
+            w, node_w_tab.dtype,
+        )
+        target, tconn, own_conn, has = _rate_rows_body(
+            labels_ref[...], node_w_tab, lw_ref[...], maxw_ref,
+            nodes, cols, W, tie_ref[...],
+            external_only=external_only, respect_caps=respect_caps,
+            tie_break=tie_break, maxw_scalar=maxw_scalar,
+        )
+        target_ref[...] = target
+        tconn_ref[...] = tconn
+        own_ref[...] = own_conn
+        has_ref[...] = has
+
+    return kernel
+
+
+def _rate_compressed_bucket(labels, node_w, label_weights, maxw_arr, stream,
+                            cb, tie, *, external_only: bool,
+                            respect_caps: bool, tie_break: str,
+                            maxw_scalar: bool):
+    w = int(cb.slot.shape[0])
+    R = int(cb.nodes.shape[0])
+    blk = max(1, min(R, _BLOCK_SLOTS // w))
+    kernel = _make_compressed_rate_kernel(
+        w, external_only, respect_caps, tie_break, maxw_scalar
+    )
+
+    def full(arr):
+        return pl.BlockSpec(
+            arr.shape, lambda i: (0,) * arr.ndim, memory_space=pltpu.VMEM
+        )
+
+    row = pl.BlockSpec((blk,), lambda i: (i,))
+    mat = pl.BlockSpec((blk, w), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(R // blk,),
+        in_specs=[full(labels), full(node_w), full(label_weights),
+                  full(maxw_arr), full(stream.words), full(stream.edge_w),
+                  row, row, row, row, row, mat],
+        out_specs=(row, row, row, row),
+        out_shape=(
+            jax.ShapeDtypeStruct((R,), labels.dtype),
+            jax.ShapeDtypeStruct((R,), node_w.dtype),
+            jax.ShapeDtypeStruct((R,), node_w.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.bool_),
+        ),
+        interpret=_interpret(),
+    )(labels, node_w, label_weights, maxw_arr, stream.words, stream.edge_w,
+      cb.nodes, cb.wstart, cb.width, cb.deg, cb.estart, tie)
+
+
+def pallas_compressed_best_moves(
+    key,
+    labels,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    label_weights,
+    max_label_weights,
+    *,
+    external_only: bool = True,
+    respect_caps: bool = True,
+    tie_break: str = "uniform",
+):
+    """Drop-in, bit-identical equivalent of lp.compressed_best_moves with
+    the per-bucket decode + rating fused into one Pallas kernel."""
+    n = gather_idx.shape[0]
+    n_pad = labels.shape[0]
+    maxw = jnp.asarray(max_label_weights)
+    maxw_scalar = maxw.ndim == 0
+    maxw_arr = maxw.reshape(1) if maxw_scalar else maxw
+    outs = []
+    for i, cb in enumerate(cbuckets):
+        bk = jax.random.fold_in(key, i)
+        R = int(cb.nodes.shape[0])
+        w = int(cb.slot.shape[0])
+        # Same tie-break key schedule as the XLA twin (_bucket_moves draws
+        # (R, w) per bucket), indexed by sorted slot inside the kernel.
+        tie = jax.random.randint(bk, (R, w), 0, _I32MAX, dtype=jnp.int32)
+        outs.append(
+            _rate_compressed_bucket(
+                labels, node_w, label_weights, maxw_arr, stream, cb, tie,
+                external_only=external_only, respect_caps=respect_caps,
+                tie_break=tie_break, maxw_scalar=maxw_scalar,
+            )
+        )
+    if heavy.nodes.shape[0] > 0:
+        outs.append(
+            _heavy_moves(
+                jax.random.fold_in(key, len(cbuckets)), labels, heavy,
+                node_w, label_weights, max_label_weights,
+                external_only=external_only, respect_caps=respect_caps,
+                tie_break=tie_break,
+            )
+        )
+    return assemble_moves(outs, gather_idx, labels, n, n_pad)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+)
+def lp_round_compressed(
+    state: LPState,
+    key,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    *,
+    num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
+) -> LPState:
+    """One decode-fused LP round; bit-identical to lp.lp_round_compressed
+    (and therefore to the dense round on the decompressed graph)."""
+    kr, kp = jax.random.split(key)
+    target, tconn, own_conn, _ = pallas_compressed_best_moves(
+        kr, state.labels, cbuckets, stream, heavy, gather_idx, node_w,
+        state.label_weights, max_label_weights,
+        external_only=False, respect_caps=True, tie_break=tie_break,
+    )
+    return commit_moves(
+        state, kp, target, tconn, own_conn, node_w, max_label_weights,
+        num_labels, active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_labels", "active_prob", "allow_tie_moves", "tie_break"),
+    donate_argnums=(0,),
+)
+def lp_iterate_compressed(
+    state: LPState,
+    key,
+    cbuckets,
+    stream,
+    heavy,
+    gather_idx,
+    node_w,
+    max_label_weights,
+    min_moved,
+    max_iterations,
+    *,
+    num_labels: int,
+    active_prob: float = 1.0,
+    allow_tie_moves: bool = False,
+    tie_break: str = "uniform",
+) -> LPState:
+    """On-device sweep loop over the decode-fused kernels — the Pallas
+    analog of lp.lp_iterate_compressed (same early-exit, same key
+    folding, one dispatch per clustering)."""
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "lp_iterate_compressed",
+        arrays=[node_w, stream.words, *(b.nodes for b in cbuckets), heavy.cols],
+        statics=(
+            "pallas", num_labels, active_prob, allow_tie_moves, tie_break,
+            jnp.asarray(max_label_weights).ndim,
+        ),
+    )
+    max_iterations = jnp.asarray(max_iterations, dtype=jnp.int32)
+
+    def cond(carry):
+        i, st = carry
+        return (i < max_iterations) & (st.num_moved > min_moved)
+
+    def body(carry):
+        i, st = carry
+        st = lp_round_compressed(
+            st, jax.random.fold_in(key, i), cbuckets, stream, heavy,
+            gather_idx, node_w, max_label_weights, num_labels=num_labels,
+            active_prob=active_prob, allow_tie_moves=allow_tie_moves,
+            tie_break=tie_break,
+        )
+        return i + 1, st
+
+    state = state._replace(num_moved=jnp.int32(jnp.iinfo(jnp.int32).max))
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+def select_compressed_iterate(choice: str):
+    """The compressed-stream LP sweep loop for the ``lp_kernel`` knob —
+    the decode-fused dispatch point shared by the compressed clusterer
+    path and the finest-level LP refinement pass."""
+    if resolve_lp_kernel(choice) == "pallas":
+        return lp_iterate_compressed
+    return lp_ops.lp_iterate_compressed
 
 
 # --------------------------------------------------------------------------

@@ -168,10 +168,15 @@ def create_largek_strong_context() -> Context:
 def create_terapart_context() -> Context:
     """Reference: ``create_terapart_context`` (presets.cc "terapart") —
     the memory-efficient tier: default pipeline over a compressed input
-    graph (graph/compressed.py)."""
+    graph (graph/compressed.py), with the finest level running directly
+    off the device-resident compressed stream (ISSUE 10;
+    graph/device_compressed.py — decode fused into the LP kernels,
+    bit-identical to the dense path, silent dense fallback outside the
+    envelope)."""
     ctx = create_default_context()
     ctx.preset_name = "terapart"
     ctx.compression.enabled = True
+    ctx.compression.device_decode = "auto"
     return ctx
 
 
@@ -179,6 +184,7 @@ def create_terapart_eco_context() -> Context:
     ctx = create_eco_context()
     ctx.preset_name = "terapart-eco"
     ctx.compression.enabled = True
+    ctx.compression.device_decode = "auto"
     return ctx
 
 
@@ -186,6 +192,7 @@ def create_terapart_largek_context() -> Context:
     ctx = _apply_largek_delta(create_default_context())
     ctx.preset_name = "terapart-largek"
     ctx.compression.enabled = True
+    ctx.compression.device_decode = "auto"
     return ctx
 
 

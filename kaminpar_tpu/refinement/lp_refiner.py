@@ -25,7 +25,13 @@ class LPRefiner(Refiner):
 
     def refine(self, p_graph: PartitionedGraph) -> PartitionedGraph:
         pv = p_graph.graph.padded()
-        bv = p_graph.graph.bucketed()
+        # Finest level under device_decode (ISSUE 10): the graph was
+        # materialized from a DeviceCompressedView and still carries it —
+        # this pass rates blocks straight off the compressed stream
+        # (decode-fused kernels) instead of the dense bucketed layout.
+        # Bit-identical to the dense pass (same key draw, same round math).
+        cview = getattr(p_graph.graph, "_compressed_view", None)
+        bv = None if cview is not None else p_graph.graph.bucketed()
         k = p_graph.k
         # Label-space shape bucket: all intermediate k of the extension
         # ladder share one compiled kernel per graph (pad labels are inert;
@@ -39,27 +45,45 @@ class LPRefiner(Refiner):
                 [max_w, jnp.zeros(k_pad - k, dtype=max_w.dtype)]
             )
 
-        from ..ops.pallas_lp import select_lp_ops
+        from ..ops.pallas_lp import select_compressed_iterate, select_lp_ops
 
-        iterate = select_lp_ops(self.ctx.lp_kernel)[0]
         with scoped_timer("lp_refinement", sync=True) as ts:
             # One dispatch, zero readbacks: the sweep loop and its
             # convergence test run on device (lp.lp_iterate_bucketed), and
             # the state carry is donated into the kernel.
-            state = iterate(
-                state,
-                next_key(),
-                bv.buckets,
-                bv.heavy,
-                bv.gather_idx,
-                pv.node_w,
-                max_w,
-                jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
-                jnp.int32(self.ctx.num_iterations),
-                num_labels=k_pad,
-                active_prob=self.ctx.active_prob,
-                allow_tie_moves=self.ctx.allow_tie_moves,
-            )
+            if cview is not None:
+                iterate = select_compressed_iterate(self.ctx.lp_kernel)
+                state = iterate(
+                    state,
+                    next_key(),
+                    cview.buckets,
+                    cview.stream,
+                    cview.heavy,
+                    cview.gather_idx,
+                    pv.node_w,
+                    max_w,
+                    jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+                    jnp.int32(self.ctx.num_iterations),
+                    num_labels=k_pad,
+                    active_prob=self.ctx.active_prob,
+                    allow_tie_moves=self.ctx.allow_tie_moves,
+                )
+            else:
+                iterate = select_lp_ops(self.ctx.lp_kernel)[0]
+                state = iterate(
+                    state,
+                    next_key(),
+                    bv.buckets,
+                    bv.heavy,
+                    bv.gather_idx,
+                    pv.node_w,
+                    max_w,
+                    jnp.int32(int(self.ctx.min_moved_fraction * pv.n)),
+                    jnp.int32(self.ctx.num_iterations),
+                    num_labels=k_pad,
+                    active_prob=self.ctx.active_prob,
+                    allow_tie_moves=self.ctx.allow_tie_moves,
+                )
             ts.note(state.labels)
             # Zero-transfer pass marker: moved count and cut deliberately
             # stay on device here (this refiner's contract is zero

@@ -316,6 +316,7 @@ class PartitionEngine:
                 self._note_warm(cell)
         self._warm_ip_pool(rung_graph)
         self._warm_lanestack(rung_graph)
+        self._warm_compressed(rung_graph)
         # Seed the retry-after service-time EMA from the warm execution
         # cost (wall minus compile/trace — the steady-state share) so the
         # very first admission rejects carry a real estimate instead of
@@ -377,6 +378,67 @@ class PartitionEngine:
                             after["trace_s"] - before["trace_s"], 3
                         ),
                     })
+
+    def _warm_compressed(self, rung_graph) -> None:
+        """Trace/compile the decode-fused compressed-stream kernels per
+        warm rung (ISSUE 10 satellite; ``kind="compressed"`` report rows,
+        printed by ``tools warmup``).  Engines serving a terapart-style
+        context (compression enabled with device decode routed on) warm
+        the compressed LP sweep cell of every rung so the first real
+        compressed request starts backend-compile-warm; other engines
+        skip the pass entirely."""
+        from ..graph.compressed import compress
+        from ..graph.device_compressed import (
+            DeviceCompressedView,
+            device_decode_eligible,
+            resolve_device_decode,
+        )
+
+        if not self.ctx.compression.enabled:
+            return
+        if resolve_device_decode(self.ctx.compression) == "off":
+            return
+        from ..coarsening.lp_clusterer import LPClustering
+        from ..utils import compile_stats
+
+        for n in self.serve.warm_ladder:
+            _, g = rung_graph(n)
+            cg = compress(g)
+            # Same envelope gate the pipeline applies: an engine whose
+            # requests will be routed dense (64-bit build, HEM clusterer)
+            # must not burn warmup compiles on kernels it can never use.
+            if not device_decode_eligible(self.ctx, cg)[0]:
+                return
+            before = compile_stats.compile_time_snapshot()
+            t0 = time.perf_counter()
+            with self.runtime.activate():
+                cv = DeviceCompressedView(
+                    cg, layout_mode=self.ctx.parallel.device_layout_build,
+                )
+                clusterer = LPClustering(self.ctx.coarsening.lp, 1)
+                labels = clusterer.compute_clustering(
+                    cv, max_cluster_weight=1 << 20
+                )
+                # Force execution so wall_s covers compile + run: ONE tiny
+                # counted readback (warmup is outside the pipeline spine;
+                # device code must not block_until_ready).
+                from ..utils import sync_stats
+
+                sync_stats.pull(labels[:1])
+            wall = time.perf_counter() - t0
+            after = compile_stats.compile_time_snapshot()
+            self.warmup_report.append({
+                "kind": "compressed",
+                "n": int(n),
+                "k": 0,  # clustering cell — no block count
+                "n_bucket": cv.n_pad,
+                "m_bucket": cv.m_pad,
+                "wall_s": round(wall, 3),
+                "backend_compile_s": round(
+                    after["backend_compile_s"] - before["backend_compile_s"], 3
+                ),
+                "trace_s": round(after["trace_s"] - before["trace_s"], 3),
+            })
 
     def _warm_ip_pool(self, rung_graph) -> None:
         """Precompile the lane-vmapped initial-bipartitioning pool per

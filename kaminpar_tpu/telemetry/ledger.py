@@ -42,6 +42,9 @@ _ABS_WALL_FLOOR_S = 0.05
 _HIGHER_BETTER_MARKERS = (
     "_gps", "edges_per_sec", "_rate", "vs_baseline", "_vs_", "gbps",
     "frac_of_peak",
+    # compress_ab (ISSUE 10): compression_ratio / resident-bytes reduction
+    # factors — a drop means the compressed tier lost ground.
+    "_ratio", "_reduction",
 )
 _LOWER_BETTER_MARKERS = (
     "_s", "_ms", "_cut", "cut", "count", "bytes", "_shapes", "fallbacks",

@@ -70,6 +70,12 @@ AUX_PHASES = (
     "dist_stats",       # shard_stats work-table collection
     "dist_extract",     # BFS-ball subgraph extraction readbacks
     "serve_pack",       # batching.pack_graphs per-member CSR readbacks
+    # Compressed-graph device pipeline (round 14, ISSUE 10): view
+    # construction (host pack -> device put, zero pulls — asserted with a
+    # 0 budget in deep.py) and the finest-level device re-materialization
+    # at final uncoarsening (one decode dispatch, zero pulls — asserted).
+    "compressed_build",
+    "compressed_decode",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

@@ -204,6 +204,66 @@ def _initial_suite(results, failures, platforms, *, use_64bit: bool = False):
     )
 
 
+def _compressed_suite(results, failures, platforms):
+    """The decode-fused compressed-graph kernels (ISSUE 10): the XLA twins
+    of the device-decode tier — the compressed LP sweep loop, the two-hop
+    pass, the flat decode, and contraction-off-the-stream — must lower for
+    TPU before the terapart pipeline meets silicon.  Covers both the
+    weighted (rmat carries dedup-summed weights) and uniform edge-stream
+    trace switches."""
+    from ..graph import generators
+    from ..graph.compressed import compress
+    from ..graph.device_compressed import (
+        DeviceCompressedView,
+        _decode_flat_padded_jit,
+    )
+    from ..ops import lp
+    from ..ops.contraction import _contract_compressed_device
+
+    key = jax.random.key(0)
+    for tag, g in (
+        ("", generators.rmat_graph(8, 8, seed=3)),      # weighted stream
+        ("_uniform", generators.grid2d_graph(16, 16)),  # all-1 dummy stream
+    ):
+        cv = DeviceCompressedView(compress(g))
+        n_pad = cv.n_pad
+        idt = cv.node_w_pad.dtype
+        labels = jnp.concatenate(
+            [
+                jnp.arange(cv.n, dtype=idt),
+                jnp.full(n_pad - cv.n, cv.anchor, dtype=idt),
+            ]
+        )
+        state = lp.init_state(labels, cv.node_w_pad, n_pad)
+        max_w = jnp.asarray(1 << 20, dtype=idt)
+        _export_one(
+            results, failures, f"lp_iterate_compressed{tag}",
+            lp.lp_iterate_compressed,
+            state, key, cv.buckets, cv.stream, cv.heavy, cv.gather_idx,
+            cv.node_w_pad, max_w, jnp.int32(1), jnp.int32(5),
+            num_labels=n_pad, active_prob=0.5, platforms=platforms,
+        )
+        if tag:
+            continue  # the remaining cells only switch on the stream shape
+        _export_one(
+            results, failures, "lp_two_hop_compressed",
+            lp.cluster_two_hop_nodes_compressed,
+            state, key, cv.buckets, cv.stream, cv.heavy, cv.gather_idx,
+            cv.node_w_pad, max_w, num_labels=n_pad, platforms=platforms,
+        )
+        _export_one(
+            results, failures, "decode_flat_padded", _decode_flat_padded_jit,
+            cv.stream, cv.wstart_pad, cv.width_pad, cv.degree_pad,
+            m_pad=cv.m_pad, platforms=platforms,
+        )
+        _export_one(
+            results, failures, "contract_compressed",
+            _contract_compressed_device,
+            labels, cv.stream, cv.wstart_pad, cv.width_pad, cv.degree_pad,
+            cv.node_w_pad, m_pad=cv.m_pad, platforms=platforms,
+        )
+
+
 def _serve_suite(results, failures, platforms):
     """The serving runtime's batch kernels (serve/batching.py): packed
     disjoint-union metrics over two graphs in one cell.  Warmup on silicon
@@ -339,6 +399,7 @@ def export_kernel_suite(
     include_x64: bool = True,
     include_serve: bool = True,
     include_initial: bool = True,
+    include_compressed: bool = True,
     mesh=None,
 ) -> Dict[str, int]:
     """Export every kernel for the target platform(s); returns name -> bytes
@@ -354,6 +415,11 @@ def export_kernel_suite(
     platforms = tuple(platforms)
 
     _shm_suite(results, failures, platforms)
+    if include_compressed:
+        # Decode-fused compressed kernels (ISSUE 10): the terapart device
+        # tier must not meet the TPU lowering rules for the first time
+        # mid-pipeline on the chip.
+        _compressed_suite(results, failures, platforms)
     if include_serve:
         # Serve batch kernels (ISSUE 3 satellite): a lowering failure here
         # is caught off-silicon instead of mid-warmup on the chip.

@@ -95,6 +95,12 @@ def child_attempt() -> None:
     # initial_partitioning_* keys land in the same salvaged record.
     os.environ.setdefault("KPTPU_BENCH_IP_AB", "1")
     os.environ.setdefault("KPTPU_BENCH_IP_SCALE", "12")
+    # Compressed device-pipeline A/B (ISSUE 10) rides run_benchmark's
+    # phase 4: dense vs decode-fused terapart at a modest on-silicon
+    # scale — this is where the HBM watermark delta (allocator stats exist
+    # on TPU, unlike the CPU fallback) becomes a measured number.
+    os.environ.setdefault("KPTPU_BENCH_COMPRESS", "1")
+    os.environ.setdefault("KPTPU_BENCH_COMPRESS_SCALE", "16")
     # Run telemetry (ISSUE 5): the full-partition phase records the unified
     # trace on-silicon; its summary (trace path, per-level quality rows,
     # HBM watermark) rides the salvaged record into TPU_RESULT.json and

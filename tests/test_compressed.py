@@ -56,6 +56,94 @@ def test_roundtrip_weighted():
     np.testing.assert_array_equal(np.asarray(out.edge_w), ew2)
 
 
+class _RawCSR:
+    """Duck-typed CSR for codec property tests — lets us feed the codec
+    streams a real generator cannot produce (max-width gaps, unsorted
+    columns) without building a 2^31-node graph."""
+
+    def __init__(self, row_ptr, col_idx, node_w=None, edge_w=None):
+        self.row_ptr = np.asarray(row_ptr, dtype=np.int64)
+        self.col_idx = np.asarray(col_idx, dtype=np.int64)
+        self.n = len(self.row_ptr) - 1
+        self.node_w = (
+            np.ones(self.n, dtype=np.int64) if node_w is None
+            else np.asarray(node_w, dtype=np.int64)
+        )
+        m = len(self.col_idx)
+        self.edge_w = (
+            np.ones(m, dtype=np.int64) if edge_w is None
+            else np.asarray(edge_w, dtype=np.int64)
+        )
+
+
+def test_roundtrip_zero_degree_and_single_node():
+    """Robustness (ISSUE 10 satellite): zero-degree nodes anywhere in the
+    stream (leading, interior, trailing) and the 1-node graph."""
+    g = _RawCSR([0, 0, 2, 2, 3, 3], [3, 4, 1])
+    cg = compress(g)
+    rp, col, nw, ew = cg.decompress_arrays()
+    np.testing.assert_array_equal(rp, [0, 0, 2, 2, 3, 3])
+    np.testing.assert_array_equal(col, [3, 4, 1])
+    assert ew is None
+    g1 = _RawCSR([0, 0], [])
+    cg1 = compress(g1)
+    rp1, col1, _, _ = cg1.decompress_arrays()
+    np.testing.assert_array_equal(rp1, [0, 0])
+    assert len(col1) == 0
+    assert cg1.memory_bytes() > 0  # metadata still accounted
+
+
+def test_roundtrip_max_gap_31bit():
+    """A neighborhood whose zig-zag gap needs the full 31/32-bit width
+    (column ids near 2^31 on a tiny node range) survives the fixed-width
+    packer; one bit more raises the documented 64-bit-path error."""
+    big = (1 << 30) + 12345
+    g = _RawCSR([0, 2, 3], [1, big, big - 7])
+    cg = compress(g)
+    assert int(cg.width.max()) >= 31
+    rp, col, _, _ = cg.decompress_arrays()
+    np.testing.assert_array_equal(col, [1, big, big - 7])
+    # gaps beyond 32 zig-zag bits must refuse, not corrupt
+    g_over = _RawCSR([0, 1], [1 << 33])
+    with pytest.raises(ValueError, match="32 bits"):
+        compress(g_over)
+
+
+def test_roundtrip_weighted_stream_and_unsorted_columns():
+    """Non-sorted input columns re-sort with their weights still aligned;
+    the weighted side stream round-trips exactly."""
+    rng = np.random.default_rng(11)
+    g = _RawCSR(
+        [0, 3, 5, 8],
+        [7, 2, 5, 9, 0, 4, 1, 6],  # deliberately unsorted per row
+        node_w=rng.integers(1, 5, 3),
+        edge_w=[10, 20, 30, 40, 50, 60, 70, 80],
+    )
+    cg = compress(g)
+    rp, col, nw, ew = cg.decompress_arrays()
+    np.testing.assert_array_equal(col, [2, 5, 7, 0, 9, 1, 4, 6])
+    np.testing.assert_array_equal(ew, [20, 30, 10, 50, 40, 70, 60, 80])
+    np.testing.assert_array_equal(nw, np.asarray(g.node_w, dtype=np.int32))
+
+
+def test_memory_bytes_matches_allocated_arrays():
+    """memory_bytes()/uncompressed_bytes() equal the actually-allocated
+    array sizes (the compress_ab bench keys on these)."""
+    g = generators.rgg2d_graph(2048, seed=9)
+    cg = compress(g)
+    expected = (
+        cg.words.nbytes + cg.word_start.nbytes + cg.width.nbytes
+        + cg.degree.nbytes + cg.node_w.nbytes
+        + (0 if cg.edge_w is None else cg.edge_w.nbytes)
+    )
+    assert cg.memory_bytes() == expected
+    rp, col, nw, ew = cg.decompress_arrays()
+    dense = rp.nbytes + col.nbytes + nw.astype(np.int32).nbytes
+    if ew is not None:
+        dense += ew.astype(np.int32).nbytes
+    assert cg.uncompressed_bytes() == dense
+
+
 def test_compression_ratio_on_local_graphs():
     """Geometric/mesh graphs have small gaps -> real compression."""
     g = generators.grid2d_graph(64, 64)
@@ -128,6 +216,10 @@ def test_terapart_releases_finest_csr(monkeypatch):
 
     s = KaMinPar("terapart")
     s.ctx.coarsening.contraction_limit = 64  # force a deep hierarchy
+    # This test pins the HOST-decompress release accounting (the storage
+    # tier); the device-decode routing (which never decompresses on host)
+    # has its own release test in tests/test_device_compressed.py.
+    s.ctx.compression.device_decode = "off"
     s.set_graph(g)
     part = s.compute_partition(k=4)
 

@@ -48,6 +48,15 @@ def test_kernel_suite_lowers_for_tpu():
         # index widths — engine warmup compiles it per cell at startup.
         "ip_pool",
         "ip_pool_x64",
+        # Decode-fused compressed kernels (ISSUE 10): both edge-stream
+        # trace switches of the sweep loop, the flat decode, and
+        # contraction-off-the-stream — the terapart device tier's cells,
+        # counted in suite_total_bytes like every other family.
+        "lp_iterate_compressed",
+        "lp_iterate_compressed_uniform",
+        "lp_two_hop_compressed",
+        "decode_flat_padded",
+        "contract_compressed",
     ):
         assert name in sizes
     # Cumulative serialized size is the suite's budget metric: a serialized
