@@ -715,6 +715,200 @@ def run_compress_phase(record: dict | None = None) -> dict:
     return record
 
 
+def run_shard_phase(record: dict | None = None) -> dict:
+    """Phase 5 (ISSUE 11): sharded deep-multilevel A/B on the P-device mesh.
+
+    Three arms at one (scale, k, seed) workload: the single-device shm deep
+    pipeline, the P-shard dist pipeline on the dense staging path, and the
+    P-shard dist pipeline off the device-resident per-shard compressed
+    streams (``device_decode``).  Per arm: end-to-end wall, per-level trace
+    rows (they ride the levels' existing counted pulls), the per-shard pull
+    census (``shard_pulls`` over the dist phases), the trace-time collective
+    census, and the HBM watermark (allocator stats exist on TPU; the static
+    resident-bytes figures are exact on every backend).  The dense-vs-
+    compressed identical-partition check is the acceptance witness; flat
+    ``shard_ab_*`` keys ride RUNS.jsonl so ``tools regress`` covers them,
+    and tpu_prober carries the phase on-silicon through run_benchmark.
+
+    Runs on whatever mesh this process has; the ``--child`` entry forces
+    ``KPTPU_BENCH_SHARD_P`` virtual CPU devices (the dryrun) unless
+    ``KPTPU_BENCH_SHARD_NATIVE=1`` keeps the ambient multi-chip mesh.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kaminpar_tpu.dist.compressed import compress_distributed
+    from kaminpar_tpu.dist.device_compressed import build_dist_device_view
+    from kaminpar_tpu.dist.partitioner import DKaMinPar
+    from kaminpar_tpu.graph import metrics as gmetrics
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.telemetry import trace as ttrace
+    from kaminpar_tpu.utils import (
+        RandomState, Timer, collective_stats, heap_profiler, sync_stats,
+    )
+    from kaminpar_tpu.utils.heap_profiler import HeapProfiler
+
+    record = dict(record or {})
+    P = int(os.environ.get("KPTPU_BENCH_SHARD_P", 8))
+    scale = int(os.environ.get("KPTPU_BENCH_SHARD_SCALE", 12))
+    k = int(os.environ.get("KPTPU_BENCH_SHARD_K", 8))
+    # Contraction limit for the mesh arms: the default C=2000 stops dryrun-
+    # sized graphs before any dist level forms; 256 gives a real hierarchy
+    # (several coarsen/uncoarsen levels) at scale 12 so the per-level rows
+    # and the coarsening pull census measure something.
+    cl = int(os.environ.get("KPTPU_BENCH_SHARD_CL", 256))
+    devs = jax.devices()
+    backend = devs[0].platform
+    if len(devs) < P:
+        raise RuntimeError(
+            f"shard_ab needs {P} devices, have {len(devs)} (the --child "
+            "entry forces virtual CPU devices; in-process callers must)"
+        )
+    mesh = Mesh(np.array(devs[:P]), ("nodes",))
+    g = rmat_graph(scale, edge_factor=8, seed=1)
+
+    # Static resident-adjacency accounting straight from the view layout
+    # (exact on every backend): dense = the three (P*m_loc,) structural
+    # arrays, compressed = words + decode metadata + ghost table.
+    dcg = compress_distributed(g, P)
+    view = build_dist_device_view(dcg)
+    dense_bytes = view.dense_resident_bytes()
+    comp_bytes = view.resident_bytes()
+    del view, dcg  # the measured arms rebuild their own
+
+    ab: dict = {
+        "backend": backend,
+        "shards": P,
+        "scale": scale,
+        "k": k,
+        "contraction_limit": cl,
+        "resident_bytes_dense": dense_bytes,
+        "resident_bytes_compressed": comp_bytes,
+        "bytes_per_edge_dense": round(dense_bytes / max(g.m, 1), 2),
+        "bytes_per_edge_compressed": round(comp_bytes / max(g.m, 1), 2),
+        "resident_reduction": round(dense_bytes / max(comp_bytes, 1), 3),
+    }
+    # The env override beats the per-arm ctx knob (resolve_device_decode);
+    # clear it so both mesh arms measure what they claim.
+    env_override = os.environ.pop("KAMINPAR_TPU_DEVICE_DECODE", None)
+    if env_override is not None:
+        ab["env_override_cleared"] = env_override
+
+    def _arm_record(wall: float, part, trace_rec) -> dict:
+        arm = {
+            "wall_s": round(wall, 2),
+            "cut": int(gmetrics.edge_cut(g, part)),
+            "hbm": heap_profiler.watermark_report(),
+        }
+        snap = sync_stats.snapshot()["phases"]
+        arm["pull_census"] = {
+            phase: {
+                "count": row["count"],
+                "shard_pulls": row.get("shard_pulls", 0),
+            }
+            for phase, row in sorted(snap.items())
+            if phase.startswith("dist_")
+        }
+        coll = collective_stats.snapshot()
+        arm["collectives_traced"] = {
+            "count": coll.get("count", 0),
+            "logical_bytes": coll.get("logical_bytes", coll.get("bytes", 0)),
+        }
+        if trace_rec is not None:
+            # Per-level rows (n, m, shrink / k per level) — they rode the
+            # levels' existing counted pulls, zero added transfers.
+            arm["levels"] = [
+                r for r in trace_rec.quality
+                if str(r.get("kind", "")).startswith("dist_")
+            ][:24]
+        return arm
+
+    # Arm 0: single-device shm deep at the same workload (the wall anchor
+    # for the dryrun; on a CPU mesh the P-shard arms pay collective overhead
+    # for no real parallelism — the honest reading is in TPU_NOTES r15).
+    RandomState.reseed(0)
+    Timer.reset_global()
+    HeapProfiler.reset(enabled=True)
+    t0 = time.perf_counter()
+    solver = KaMinPar("default")
+    solver.ctx.seed = 1
+    solver.set_graph(g)
+    part_single = solver.compute_partition(k, epsilon=0.03)
+    ab["single"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "cut": int(gmetrics.edge_cut(g, part_single)),
+        "hbm": heap_profiler.watermark_report(),
+    }
+    HeapProfiler.reset(enabled=False)
+
+    def _mesh_ctx(compress: bool, mode: str):
+        ctx = create_context_by_preset_name("default")
+        ctx.seed = 1
+        ctx.coarsening.contraction_limit = cl
+        ctx.compression.enabled = compress
+        ctx.compression.device_decode = mode
+        return ctx
+
+    parts: dict = {}
+    for tag, compress, mode in (
+        ("dense", False, "off"), ("compressed", True, "finest")
+    ):
+        RandomState.reseed(0)
+        Timer.reset_global()
+        sync_stats.reset()
+        collective_stats.reset()
+        trace_rec = None if ttrace.active() is not None else ttrace.start()
+        HeapProfiler.reset(enabled=True)
+        t0 = time.perf_counter()
+        try:
+            parts[tag] = DKaMinPar(mesh, _mesh_ctx(compress, mode)).compute_partition(
+                g, k=k, epsilon=0.03
+            )
+        finally:
+            wall = time.perf_counter() - t0
+            if trace_rec is not None:
+                ttrace.stop()
+        ab[tag] = _arm_record(wall, parts[tag], trace_rec)
+        HeapProfiler.reset(enabled=False)
+    if env_override is not None:
+        os.environ["KAMINPAR_TPU_DEVICE_DECODE"] = env_override
+
+    # Acceptance witness: the compressed mesh arm is bit-identical to the
+    # dense mesh arm (same seed, same mesh, decode-fused kernels).
+    ab["identical_partition"] = bool(
+        np.array_equal(parts["dense"], parts["compressed"])
+    )
+    peaks = [
+        ab[tag].get("hbm", {}).get("peak_bytes_in_use")
+        for tag in ("dense", "compressed")
+    ]
+    if all(isinstance(p, int) for p in peaks):
+        ab["hbm_peak_delta_bytes"] = peaks[0] - peaks[1]
+    record["shard_ab"] = ab
+    # Flat ledger keys (telemetry/ledger: *_wall_s/_bytes/count lower-better,
+    # *_reduction higher-better; covered by the tools regress windows).
+    comp_pulls = sum(
+        row["shard_pulls"] for row in ab["compressed"]["pull_census"].values()
+    )
+    record.update({
+        "shard_ab_single_wall_s": ab["single"]["wall_s"],
+        "shard_ab_dense_wall_s": ab["dense"]["wall_s"],
+        "shard_ab_compressed_wall_s": ab["compressed"]["wall_s"],
+        "shard_ab_resident_bytes_dense": dense_bytes,
+        "shard_ab_resident_bytes_compressed": comp_bytes,
+        "shard_ab_resident_reduction": ab["resident_reduction"],
+        "shard_ab_identical": int(ab["identical_partition"]),
+        "shard_ab_shard_pull_count": comp_pulls,
+        "shard_ab_collective_bytes":
+            ab["compressed"]["collectives_traced"]["logical_bytes"],
+    })
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def run_benchmark() -> dict:
     """All phases in-process (used by the prober child and --child mode).
     Returns the final headline record (the ledger entry's source)."""
@@ -728,6 +922,21 @@ def run_benchmark() -> dict:
             record = run_compress_phase(record)
         except Exception as exc:  # noqa: BLE001 — A/B must not void phases 1-3
             record["compress_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("KPTPU_BENCH_SHARD", "1") == "1":
+        # Phase 5 needs its own device topology (P virtual CPU devices for
+        # the dryrun — the backend here is already initialized, possibly
+        # with one device), so it always runs in a child process.
+        shard_timeout = float(os.environ.get("KPTPU_BENCH_SHARD_TIMEOUT", 900))
+        shard_rec, shard_err = _run_child(shard_timeout, extra_env={
+            "KPTPU_BENCH_PHASE": "shard",
+        })
+        if shard_rec and "shard_ab" in shard_rec:
+            for key, val in shard_rec.items():
+                if key.startswith("shard_ab"):
+                    record[key] = val
+            print(json.dumps(record), flush=True)
+        else:
+            record["shard_ab_error"] = shard_err or "shard phase produced no record"
     return record
 
 
@@ -924,6 +1133,19 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
                     rec[key] = val
         else:
             rec["serve_error"] = serve_err or "serve phase produced no record"
+    # Phase 5 (shard_ab, ISSUE 11) in its own child: it forces its own
+    # virtual 8-device CPU mesh regardless of this process's 1-device pin.
+    if os.environ.get("KPTPU_BENCH_SHARD", "1") == "1":
+        shard_timeout = float(os.environ.get("KPTPU_BENCH_SHARD_TIMEOUT", 900))
+        shard_rec, shard_err = _run_child(shard_timeout, extra_env={
+            "KPTPU_BENCH_PHASE": "shard",
+        })
+        if shard_rec and "shard_ab" in shard_rec:
+            for key, val in shard_rec.items():
+                if key.startswith("shard_ab"):
+                    rec[key] = val
+        else:
+            rec["shard_ab_error"] = shard_err or "shard phase produced no record"
     rec.setdefault("git_head", _git_head())
     rec.setdefault("stale_vs_head", False)  # fallback measured at head
     print(json.dumps(rec))
@@ -932,11 +1154,21 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
 
 def main() -> None:
     if "--child" in sys.argv:
+        phase = os.environ.get("KPTPU_BENCH_PHASE")
+        if phase == "shard":
+            # The 8-device CPU-mesh dryrun (ISSUE 11): force the virtual
+            # mesh BEFORE the backend initializes, unless the caller pinned
+            # the ambient multi-chip mesh (KPTPU_BENCH_SHARD_NATIVE=1).
+            if os.environ.get("KPTPU_BENCH_SHARD_NATIVE") != "1":
+                from kaminpar_tpu.utils.platform import force_cpu_devices
+
+                force_cpu_devices(int(os.environ.get("KPTPU_BENCH_SHARD_P", 8)))
+            run_shard_phase()
+            return
         if os.environ.get("KPTPU_CHILD_FORCE_CPU") == "1":
             from kaminpar_tpu.utils.platform import force_cpu_devices
 
             force_cpu_devices(1)
-        phase = os.environ.get("KPTPU_BENCH_PHASE")
         if phase == "full":
             run_full_phase()
         elif phase == "serve":

@@ -735,6 +735,11 @@ class GraphCompressionContext:
     #   envelope (64-bit build, HEM clustering, v-cycle communities).
     # - "auto": like "finest" but falls back silently.
     # KAMINPAR_TPU_DEVICE_DECODE overrides.
+    # The dist tier consumes the SAME knob (round 15,
+    # dist/device_compressed.py): under it the finest dist level's
+    # adjacency stays resident as per-shard gap streams and the LP/
+    # contraction kernels decode in-kernel inside shard_map (envelope:
+    # 32-bit + GLOBAL_LP dist clustering; dense staging fallback).
     device_decode: str = "off"
 
 

@@ -13,3 +13,12 @@ from .lp import (  # noqa: F401
     dist_lp_iterate,
     dist_lp_round,
 )
+from .compressed import (  # noqa: F401
+    DistributedCompressedGraph,
+    compress_distributed,
+)
+from .device_compressed import (  # noqa: F401
+    DistDeviceCompressedView,
+    build_dist_device_view,
+    materialize_dist_graph,
+)

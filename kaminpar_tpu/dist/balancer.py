@@ -338,7 +338,8 @@ def _cluster_balance_round_body(
 
 
 @lru_cache(maxsize=None)
-def make_dist_cluster_balance_round(mesh: Mesh, *, k: int):
+def make_dist_cluster_balance_round(mesh: Mesh, *, k: int,
+                                    donate: bool = False):
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -353,11 +354,11 @@ def make_dist_cluster_balance_round(mesh: Mesh, *, k: int):
             send_idx, recv_map, k=k,
         )
 
-    return jax.jit(round_fn)
+    return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
 
 
 @lru_cache(maxsize=None)
-def make_dist_balance_round(mesh: Mesh, *, k: int):
+def make_dist_balance_round(mesh: Mesh, *, k: int, donate: bool = False):
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -372,16 +373,16 @@ def make_dist_balance_round(mesh: Mesh, *, k: int):
             send_idx, recv_map, k=k,
         )
 
-    return jax.jit(round_fn)
+    return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
 
 
 def dist_cluster_balance(mesh, key, labels, graph, max_bw, *, k: int,
-                         max_rounds: int = 8):
+                         max_rounds: int = 8, donate: bool = False):
     """Drive deterministic cluster-balance rounds (reference:
     cluster_balancer.cc).  Returns (labels, feasible)."""
     from ..utils import sync_stats
 
-    fn = make_dist_cluster_balance_round(mesh, k=k)
+    fn = make_dist_cluster_balance_round(mesh, k=k, donate=donate)
     for i in range(max_rounds):
         labels, stats = fn(
             jax.random.fold_in(key, i), labels, graph.node_w, graph.edge_u,
@@ -399,16 +400,18 @@ def dist_cluster_balance(mesh, key, labels, graph, max_bw, *, k: int,
 
 
 def dist_balance(mesh, key, labels, graph, max_bw, *, k: int,
-                 max_rounds: int = 16):
+                 max_rounds: int = 16, donate: bool = False):
     """Drive balance rounds until feasible or the budget is exhausted.
 
     Node rounds first; when they go dry (3 consecutive rounds without a
     move — the reference's escalation point), whole-cluster moves take
     over (``dist_cluster_balance``).  Returns (labels, feasible).
-    ``max_bw`` is a (k,) block-weight cap."""
+    ``max_bw`` is a (k,) block-weight cap.  ``donate`` releases each
+    round's input labels (incl. the caller's — the pipeline's rebind-only
+    call sites opt in; external callers that reuse their array must not)."""
     from ..utils import sync_stats
 
-    fn = make_dist_balance_round(mesh, k=k)
+    fn = make_dist_balance_round(mesh, k=k, donate=donate)
     feasible = False
     dry = 0
     for i in range(max_rounds):
@@ -430,6 +433,7 @@ def dist_balance(mesh, key, labels, graph, max_bw, *, k: int,
             break
     if not feasible:
         labels, feasible = dist_cluster_balance(
-            mesh, jax.random.fold_in(key, 1 << 20), labels, graph, max_bw, k=k
+            mesh, jax.random.fold_in(key, 1 << 20), labels, graph, max_bw,
+            k=k, donate=donate,
         )
     return labels, feasible

@@ -4,14 +4,19 @@ Role counterpart: kaminpar-dist/datastructures/distributed_compressed_graph
 .{h,cc} (~800 LoC) — each PE keeps its node range's adjacency gap-encoded
 and decodes neighborhoods on the fly, cutting per-PE resident memory.
 
-TPU redesign: traversal here runs as device kernels over CSR shards, so
-the compressed form's job is the *host staging* footprint: between IO and
-device upload, the graph exists only gap-packed (graph/compressed.py's
-fixed-width codec, applied per shard in shard-relative coordinates), and
-``to_dist_graph`` materializes ONE shard's CSR at a time — peak host
-memory O(compressed + one shard) instead of O(m).  The price is decoding
-each shard twice (once for the ghost-routing externals, once for the
-device slices); decode is a vectorized NumPy pass, cheap next to IO.
+TPU redesign, two tiers:
+
+- **Host staging** (this module): between IO and device upload the graph
+  exists only gap-packed (graph/compressed.py's fixed-width codec, applied
+  per shard in shard-relative coordinates), and ``to_dist_graph``
+  materializes ONE shard's CSR at a time — peak host memory
+  O(compressed + one shard) instead of O(m).  Each shard is decoded
+  exactly once (round 15; the original two-pass form decoded twice).
+- **Device residency** (dist/device_compressed.py, round 15): under
+  ``compression.device_decode`` the per-shard gap words + decode metadata
+  become the *resident* adjacency on the mesh and the finest dist level's
+  LP/contraction kernels decode in-trace — ``decompress_arrays`` is never
+  called on that path after the view build.
 """
 
 from __future__ import annotations
@@ -46,6 +51,14 @@ class DistributedCompressedGraph:
     def total_node_weight(self) -> int:
         return int(sum(s.total_node_weight for s in self.shards))
 
+    @property
+    def max_node_weight(self) -> int:
+        # CompressedGraph.node_w is host numpy by construction (the codec
+        # never touches the device); a plain reduction, not a transfer.
+        return int(max(
+            (int(s.node_w.max(initial=0)) for s in self.shards), default=0,  # kpt: ignore[sync-discipline] — CompressedGraph.node_w is host numpy
+        ))
+
     def memory_bytes(self) -> int:
         return int(sum(s.memory_bytes() for s in self.shards))
 
@@ -73,37 +86,39 @@ class DistributedCompressedGraph:
     def to_dist_graph(self, dtype=np.int32) -> DistGraph:
         """Materialize the device-side DistGraph shard by shard (same
         layout contract as graph.distribute_graph, including its
-        minimum-8 pow2 floors and ew>0 ghost filtering)."""
+        minimum-8 pow2 floors and ew>0 ghost filtering).
+
+        Each shard is decoded exactly ONCE: the per-shard edge counts come
+        from the compressed metadata (``CompressedGraph.m``), and the ghost
+        routing is resolved against the shard's OWN sorted-unique external
+        ids (``build_ghost_exchange`` derives the identical numbering), so
+        the single decoded pass can both collect the routing externals and
+        emit the device slices.  Only the pad *value* depends on the not-
+        yet-known global ghost capacity ``g_loc`` — pads are written with a
+        sentinel and rewritten in one fused device op at the end (a device
+        compute, not a transfer).  Host peak stays O(compressed + one
+        shard); the previous two-pass form decoded every shard twice."""
         P, n_loc = self.num_shards, self.n_loc
+        m_loc = next_pow2(max(max(s.m for s in self.shards), 1), 8)
+        # Provisional pad slot: localize_columns writes n_loc + g_loc; pass
+        # a sentinel "g_loc" no real ghost count can reach, fix up below.
+        g_sentinel = 2**30
 
-        # Pass 1: per-shard edge counts + external columns of real edges
-        # (the only part of the adjacency the ghost routing needs).
-        counts, ext_cols = [], []
-        for s in range(P):
-            _, col, _, ew = self._shard_arrays(s)
-            counts.append(len(col))
-            lo, hi = s * n_loc, (s + 1) * n_loc
-            ext = ((col < lo) | (col >= hi)) & (ew > 0)
-            ext_cols.append(col[ext].astype(dtype))
-            del col, ew
-        m_loc = next_pow2(max(max(counts), 1), 8)
-
-        send_idx, recv_map, ghost_global, cap_g, g_loc = build_ghost_exchange(
-            ext_cols, [np.ones(len(e), bool) for e in ext_cols], n_loc, P,
-            dtype=dtype,
-        )
-
-        # Pass 2: device slices, one shard at a time.
+        ext_cols = []
         node_w_parts, eu_parts, ew_parts, cl_parts = [], [], [], []
         for s in range(P):
-            rp, col, nwr, ewr = self._shard_arrays(s)
+            rp, col, nwr, ewr = self._shard_arrays(s)  # the ONE decode
             rp = rp.astype(np.int64)
             n_s = len(rp) - 1
+            lo, hi = s * n_loc, (s + 1) * n_loc
+            ext = ((col < lo) | (col >= hi)) & (ewr > 0)
+            gg = np.unique(col[ext]).astype(dtype)
+            ext_cols.append(gg)
             nw = np.zeros(n_loc, dtype=dtype)
             nw[:n_s] = nwr
             eu = np.zeros(m_loc, dtype=dtype)
             ew = np.zeros(m_loc, dtype=dtype)
-            colbuf = np.zeros(m_loc, dtype=dtype)
+            colbuf = np.zeros(m_loc, dtype=np.int64)
             valid = np.zeros(m_loc, dtype=bool)
             cnt = len(col)
             eu[:cnt] = np.repeat(np.arange(n_s, dtype=dtype), np.diff(rp))
@@ -111,7 +126,7 @@ class DistributedCompressedGraph:
             colbuf[:cnt] = col
             valid[:cnt] = ew[:cnt] > 0
             cl = localize_columns(
-                colbuf, valid, ghost_global[s], s, n_loc, g_loc, dtype
+                colbuf, valid, gg, s, n_loc, g_sentinel, dtype
             )
             node_w_parts.append(jnp.asarray(nw))
             eu_parts.append(jnp.asarray(eu))
@@ -119,10 +134,23 @@ class DistributedCompressedGraph:
             cl_parts.append(jnp.asarray(cl))
             del rp, col, nwr, ewr, nw, eu, ew, colbuf, valid, cl
 
+        # The routing build re-derives each shard's ghost set from the
+        # already-unique externals — np.unique is idempotent, so the slot
+        # numbering matches the localization above exactly.
+        send_idx, recv_map, ghost_global, cap_g, g_loc = build_ghost_exchange(
+            ext_cols, [np.ones(len(e), bool) for e in ext_cols], n_loc, P,
+            dtype=dtype,
+        )
+        col_loc = jnp.concatenate(cl_parts)
+        col_loc = jnp.where(
+            col_loc == n_loc + g_sentinel,
+            jnp.asarray(n_loc + g_loc, col_loc.dtype), col_loc,
+        )
+
         return DistGraph(
             node_w=jnp.concatenate(node_w_parts),
             edge_u=jnp.concatenate(eu_parts),
-            col_loc=jnp.concatenate(cl_parts),
+            col_loc=col_loc,
             edge_w=jnp.concatenate(ew_parts),
             send_idx=jnp.asarray(send_idx),
             recv_map=jnp.asarray(recv_map),

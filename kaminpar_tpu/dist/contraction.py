@@ -91,6 +91,54 @@ def _s1(mesh, labels, node_w, *, n_loc: int, cap_q: int):
     return body(labels, node_w)
 
 
+def _s2_core(labels_loc, cmap_own_loc, cw_own_loc, eu, cl, ew, sidx, rmap, *,
+             n_loc: int, n_loc_c: int, cap_q: int):
+    """S2 per-shard core (inside shard_map), shared by the dense ``_s2``
+    wrapper below and the decode-fused compressed twin
+    (dist/device_compressed._s2c): coarse endpoints via owner queries, then
+    route coarse edges + node weights by their coarse-layout owner."""
+    nshards = jax.lax.axis_size(AXIS)
+    ghost_labels = ghost_exchange(
+        labels_loc, sidx, rmap, fill=jnp.asarray(-1, labels_loc.dtype)
+    )
+    qkeys = jnp.concatenate([labels_loc, ghost_labels])
+    qdrop = qkeys < 0
+    cvals, ovf = owner_query(
+        qkeys, qdrop, cmap_own_loc, n_loc, cap_q,
+        fill=jnp.asarray(-1, labels_loc.dtype),
+    )
+    g_loc = ghost_labels.shape[0]
+    cmap_slot = jnp.concatenate(
+        [cvals, jnp.full((1,), -1, cvals.dtype)]
+    )  # (n_loc + g_loc + 1,)
+    cu_node = cvals[:n_loc]  # coarse id of each local node (= coarse_of)
+    cu = cu_node[eu]
+    cv = cmap_slot[jnp.clip(cl, 0, n_loc + g_loc)]
+    keep = (ew > 0) & (cu != cv) & (cu >= 0) & (cv >= 0)
+
+    # route edges by owner shard of cu under the coarse layout
+    dest = jnp.where(keep, cu // n_loc_c, nshards).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(dest), dest, num_segments=nshards + 1
+    )[:nshards]
+
+    # route coarse node weights by owner of the compact id
+    used = cmap_own_loc >= 0
+    wdest = jnp.where(used, cmap_own_loc // n_loc_c, nshards).astype(jnp.int32)
+    worder = jnp.argsort(wdest, stable=True)
+    wcounts = jax.ops.segment_sum(
+        jnp.ones_like(wdest), wdest, num_segments=nshards + 1
+    )[:nshards]
+
+    return (
+        cu_node,
+        cu[order], cv[order], jnp.where(keep, ew, 0)[order], counts,
+        cmap_own_loc[worder], cw_own_loc[worder], wcounts,
+        psum(ovf, AXIS),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=("mesh", "n_loc", "n_loc_c", "cap_q"),
@@ -106,45 +154,9 @@ def _s2(mesh, labels, cmap_own, cw_own, edge_u, col_loc, edge_w, send_idx,
                    P(AXIS), P(AXIS), P(AXIS), P()),
     )
     def body(labels_loc, cmap_own_loc, cw_own_loc, eu, cl, ew, sidx, rmap):
-        nshards = jax.lax.axis_size(AXIS)
-        ghost_labels = ghost_exchange(
-            labels_loc, sidx, rmap, fill=jnp.asarray(-1, labels_loc.dtype)
-        )
-        qkeys = jnp.concatenate([labels_loc, ghost_labels])
-        qdrop = qkeys < 0
-        cvals, ovf = owner_query(
-            qkeys, qdrop, cmap_own_loc, n_loc, cap_q,
-            fill=jnp.asarray(-1, labels_loc.dtype),
-        )
-        g_loc = ghost_labels.shape[0]
-        cmap_slot = jnp.concatenate(
-            [cvals, jnp.full((1,), -1, cvals.dtype)]
-        )  # (n_loc + g_loc + 1,)
-        cu_node = cvals[:n_loc]  # coarse id of each local node (= coarse_of)
-        cu = cu_node[eu]
-        cv = cmap_slot[jnp.clip(cl, 0, n_loc + g_loc)]
-        keep = (ew > 0) & (cu != cv) & (cu >= 0) & (cv >= 0)
-
-        # route edges by owner shard of cu under the coarse layout
-        dest = jnp.where(keep, cu // n_loc_c, nshards).astype(jnp.int32)
-        order = jnp.argsort(dest, stable=True)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(dest), dest, num_segments=nshards + 1
-        )[:nshards]
-
-        # route coarse node weights by owner of the compact id
-        used = cmap_own_loc >= 0
-        wdest = jnp.where(used, cmap_own_loc // n_loc_c, nshards).astype(jnp.int32)
-        worder = jnp.argsort(wdest, stable=True)
-        wcounts = jax.ops.segment_sum(
-            jnp.ones_like(wdest), wdest, num_segments=nshards + 1
-        )[:nshards]
-
-        return (
-            cu_node,
-            cu[order], cv[order], jnp.where(keep, ew, 0)[order], counts,
-            cmap_own_loc[worder], cw_own_loc[worder], wcounts,
-            psum(ovf, AXIS),
+        return _s2_core(
+            labels_loc, cmap_own_loc, cw_own_loc, eu, cl, ew, sidx, rmap,
+            n_loc=n_loc, n_loc_c=n_loc_c, cap_q=cap_q,
         )
 
     return body(labels, cmap_own, cw_own, edge_u, col_loc, edge_w,
@@ -241,7 +253,15 @@ def contract_dist_clustering(
 ) -> Tuple[DistGraph, jax.Array, int]:
     """Contract a distributed clustering; returns (coarse graph, coarse_of,
     n_c) where ``coarse_of`` holds each fine node's *global coarse id* (used
-    by uncoarsening projection; -1 on pad nodes)."""
+    by uncoarsening projection; -1 on pad nodes).
+
+    ``graph`` may also be a :class:`~kaminpar_tpu.dist.device_compressed.
+    DistDeviceCompressedView`: the decode-fused S2 twin runs instead and
+    the adjacency never materializes as resident dense arrays."""
+    if getattr(graph, "is_compressed_view", False):
+        from .device_compressed import contract_dist_compressed
+
+        return contract_dist_compressed(mesh, graph, labels, cap_q=cap_q)
     Pn = graph.num_shards
     n_loc = graph.n_loc
     if cap_q is None:

@@ -1,0 +1,620 @@
+"""Device-resident per-shard compressed streams — the dist TeraPart tier.
+
+Role counterpart: ``kaminpar-dist/datastructures/distributed_compressed_graph
+.{h,cc}`` — each PE keeps its node range's adjacency gap-encoded and decodes
+neighborhoods on the fly.  The reference decodes inside its traversal loops;
+PR 10 (graph/device_compressed.py) proved the TPU analog on a single chip:
+fixed-width gap words decode with one two-word gather + funnel shift per
+edge, fused into the consuming kernel.  This module carries that tier onto
+the mesh:
+
+- :class:`DistDeviceCompressedView` — the sharded twin of :class:`DistGraph`
+  whose three m-sized structural arrays (``edge_u``/``col_loc``/``edge_w``)
+  are replaced by per-shard packed gap words + per-node decode metadata
+  (``wstart``/``width``/``deg``) and a per-shard sorted ghost-id table.
+  Columns are stored *shard-relative* (graph/compressed.py's signed first
+  gap keeps them small at shard boundaries), so decode recovers local slots
+  without any m-sized resident array.  Everything is a flat ``(P * per,)``
+  array so ``PartitionSpec('nodes')`` splits it per shard — exactly the
+  DistGraph layout contract.
+- :func:`decode_shard_adjacency` — the in-trace per-shard decode, emitting
+  ``(edge_u, col_loc, edge_w)`` **bit-identical** to the dense DistGraph's
+  shard slices (same pad conventions, same ghost-slot numbering), so the
+  existing dist round bodies consume it unchanged and bit-identity with the
+  dense path is by construction, not by hope.
+- decode-fused ``shard_map`` kernels: the global LP clustering round, the LP
+  refinement round, and contraction stage S2 (:func:`_s2c`) each start with
+  the decode and then run the *shared* dense bodies
+  (dist/lp.py / dist/contraction.py) on the transient arrays.
+- :func:`materialize_dist_graph` — ONE sharded decode dispatch producing the
+  dense :class:`DistGraph` (zero blocking transfers) for the refiners that
+  stay dense (balancer / CLP / JET / extension), mirroring PR 10's finest
+  re-materialization.
+
+Envelope: the 32-bit build with ``GLOBAL_LP`` dist clustering (the other
+clusterers walk matchings or need shard-local labels; they fall back to the
+dense staging path, loudly under ``device_decode=finest``).
+``GraphCompressionContext.device_decode`` gates the routing —
+the SAME knob as the shm tier, so ``terapart`` presets engage both.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graph.device_compressed import _funnel_unpack
+from ..utils import sync_stats
+from ..utils.intmath import next_pow2
+from .contraction import _assemble_coarse, _s1, _s2_core, _s3, _s4
+from .exchange import AXIS, build_ghost_exchange
+from .graph import DistGraph, compute_shard_work
+from .lp import _cluster_round_body, _refine_round_body
+
+__all__ = [
+    "DistDeviceCompressedView",
+    "build_dist_device_view",
+    "build_dist_view_if_eligible",
+    "decode_shard_adjacency",
+    "materialize_dist_graph",
+    "dist_cluster_iterate_compressed",
+    "dist_lp_iterate_compressed",
+    "contract_dist_compressed",
+]
+
+_GHOST_PAD = np.iinfo(np.int32).max  # sorted-table sentinel (> any global id)
+
+
+class DistDeviceCompressedView(NamedTuple):
+    """Sharded device arrays + host metadata; the NamedTuple itself is never
+    traced (DistGraph convention).  ``edge_w_stream`` is a (P,) zero dummy
+    when every shard's weights are uniform all-1 — ``has_edge_w`` is the
+    static trace-time switch."""
+
+    words: jax.Array  # (P * w_loc,) uint32 packed zig-zag gap words
+    wstart: jax.Array  # (P * n_loc,) shard-local first word per node
+    width: jax.Array  # (P * n_loc,) bits per gap (pads 1)
+    deg: jax.Array  # (P * n_loc,) degree (pads 0)
+    node_w: jax.Array  # (P * n_loc,) node weights, pads 0
+    edge_w_stream: jax.Array  # (P * m_loc,) decode-order weights or (P,) dummy
+    ghost_sorted: jax.Array  # (P * g_loc,) sorted ghost GLOBAL ids, pads MAX
+    send_idx: jax.Array  # ghost-exchange routing (DistGraph contract)
+    recv_map: jax.Array
+    ghost_global: tuple  # host: per-shard np arrays of ghost global ids
+    n: int
+    m: int
+    n_loc: int
+    m_loc: int
+    w_loc: int
+    g_loc: int
+    cap_g: int
+    num_shards: int
+    has_edge_w: bool
+    shard_work: tuple = ()
+
+    @property
+    def N(self) -> int:
+        return self.num_shards * self.n_loc
+
+    @property
+    def dtype(self):
+        return self.node_w.dtype
+
+    @property
+    def is_compressed_view(self) -> bool:
+        """Dispatch marker consumed by shard_arrays / contract_dist_clustering
+        (DistGraph lacks the attribute; ``getattr(..., False)`` reads it)."""
+        return True
+
+    # -- memory accounting (bench shard_ab) ---------------------------------
+
+    def resident_bytes(self) -> int:
+        """Device-resident bytes of the compressed adjacency tier: the word
+        stream + per-node decode metadata + ghost table + (when non-uniform)
+        the weight side stream.  node_w and the exchange routing are common
+        to both tiers and excluded — this measures the *adjacency* delta."""
+        b = self.words.nbytes + self.wstart.nbytes + self.width.nbytes
+        b += self.deg.nbytes + self.ghost_sorted.nbytes
+        if self.has_edge_w:
+            b += self.edge_w_stream.nbytes
+        return int(b)
+
+    def dense_resident_bytes(self) -> int:
+        """What the dense DistGraph keeps resident for the same adjacency:
+        the three (P * m_loc,) structural arrays."""
+        itemsize = self.node_w.dtype.itemsize
+        return int(3 * self.num_shards * self.m_loc * itemsize)
+
+
+# -- in-trace decode ---------------------------------------------------------
+
+
+def decode_shard_adjacency(words, wstart, width, deg, ew_stream, ghost_sorted,
+                           *, m_loc: int, has_edge_w: bool):
+    """Per-shard in-trace decode (inside ``shard_map``): rebuild this shard's
+    ``(edge_u, col_loc, edge_w)`` slices exactly as the dense staging path
+    lays them out (dist/graph.distribute_graph / compressed.to_dist_graph):
+
+    - ``edge_u``: local row per real edge slot, 0 on pads;
+    - ``col_loc``: local node slot for in-shard targets, ``n_loc + slot`` for
+      ghosts (slot = position in the shard's sorted-unique ghost table, found
+      here by binary search instead of the host's precomputed rewrite),
+      ``n_loc + g_loc`` wherever the edge weight is zero (pads AND real
+      zero-weight edges — the dense builder's ``valid = ew > 0`` rule);
+    - ``edge_w``: decode-order weights (the side stream IS the dense array)
+      or the constant 1 on real slots.
+
+    Per edge: one gather of two consecutive words + funnel shift/mask
+    (widths are <= 32 so a gap straddles at most one boundary), zig-zag
+    decode, then a segmented cumsum turns gaps into shard-relative columns.
+    The cumsum may wrap int32 across rows; the per-row rebase subtraction
+    cancels the wrap exactly (two's complement), so columns are exact
+    whenever they fit int32 — the 32-bit envelope.
+    """
+    idt = deg.dtype
+    n_loc = deg.shape[0]
+    g_loc = ghost_sorted.shape[0]
+    rp = jnp.concatenate([jnp.zeros(1, idt), jnp.cumsum(deg).astype(idt)])
+    m_real = rp[n_loc].astype(jnp.int32)
+    slot = jnp.arange(m_loc, dtype=jnp.int32)
+    # scatter-of-row-starts cumsum: each slot lands on its owning row; the
+    # tail (>= m_real) accumulates every trailing empty row and is masked.
+    marks = jnp.zeros(m_loc, jnp.int32).at[
+        rp[:-1].astype(jnp.int32)
+    ].add(1, mode="drop")
+    eu_raw = jnp.clip(jnp.cumsum(marks) - 1, 0, n_loc - 1)
+    pos = slot - rp[eu_raw].astype(jnp.int32)
+    wd = width[eu_raw].astype(jnp.int32)
+    bit = pos * wd
+    w0 = wstart[eu_raw].astype(jnp.int32) + (bit >> 5)
+    gap = _funnel_unpack(words, w0, bit & 31, wd)
+    valid = slot < m_real
+    firsts = pos == 0
+    vals = jnp.where(valid, jnp.where(firsts, eu_raw + gap, gap), 0)
+    c = jnp.cumsum(vals)
+    row_base = jnp.concatenate([jnp.zeros(1, c.dtype), c])[
+        rp[:-1].astype(jnp.int32)
+    ]
+    col_rel = c - row_base[eu_raw]
+
+    if has_edge_w:
+        ew = ew_stream.astype(idt)  # already the dense layout incl. 0 pads
+    else:
+        ew = valid.astype(idt)
+    edge_u = jnp.where(valid, eu_raw, 0).astype(idt)
+    live = valid & (ew > 0)
+    local = live & (col_rel >= 0) & (col_rel < n_loc)
+    idx = jax.lax.axis_index(AXIS)
+    gcol = col_rel + idx.astype(col_rel.dtype) * n_loc
+    gslot = jnp.searchsorted(
+        ghost_sorted, gcol.astype(ghost_sorted.dtype)
+    ).astype(jnp.int32)
+    col_loc = jnp.where(
+        local, col_rel,
+        jnp.where(live, n_loc + gslot, n_loc + g_loc),
+    ).astype(idt)
+    return edge_u, col_loc, ew
+
+
+def shard_view_arrays(mesh: Mesh, view: DistDeviceCompressedView, labels):
+    """Place the view + label arrays with their 1D shardings (the
+    :func:`~kaminpar_tpu.dist.lp.shard_arrays` twin for compressed levels)."""
+    s = NamedSharding(mesh, P(AXIS))
+    return (
+        jax.device_put(labels, s),
+        view._replace(
+            words=jax.device_put(view.words, s),
+            wstart=jax.device_put(view.wstart, s),
+            width=jax.device_put(view.width, s),
+            deg=jax.device_put(view.deg, s),
+            node_w=jax.device_put(view.node_w, s),
+            edge_w_stream=jax.device_put(view.edge_w_stream, s),
+            ghost_sorted=jax.device_put(view.ghost_sorted, s),
+            send_idx=jax.device_put(view.send_idx, s),
+            recv_map=jax.device_put(view.recv_map, s),
+        ),
+    )
+
+
+# -- host build --------------------------------------------------------------
+
+
+def build_dist_device_view(dcg) -> DistDeviceCompressedView:
+    """Build the device view from a host :class:`DistributedCompressedGraph`.
+
+    Each shard is decoded ONCE, for the ghost-routing externals only (the
+    columns the exchange builder needs); the resident device arrays come
+    straight from the compressed fields — no dense per-shard CSR slice is
+    ever materialized, host or device.  Peak host memory stays
+    O(compressed + one decoded shard).
+    """
+    Pn, n_loc = dcg.num_shards, dcg.n_loc
+    idt = np.int32
+    m_loc = next_pow2(max(max(s.m for s in dcg.shards), 1), 8)
+
+    ext_cols, owned_edges = [], []
+    for s in range(Pn):
+        _, col, _, ew = dcg._shard_arrays(s)  # the ONE decode of shard s
+        lo, hi = s * n_loc, (s + 1) * n_loc
+        ext = ((col < lo) | (col >= hi)) & (ew > 0)
+        ext_cols.append(col[ext].astype(idt))
+        owned_edges.append(int((ew > 0).sum()))
+        del col, ew
+
+    send_idx, recv_map, ghost_global, cap_g, g_loc = build_ghost_exchange(
+        ext_cols, [np.ones(len(e), bool) for e in ext_cols], n_loc, Pn,
+        dtype=idt,
+    )
+
+    # Word stream: strictly > real length per shard so the straddle read at
+    # +1 stays in bounds at the last real word (compress() already appends a
+    # sentinel word; the pow2 pad keeps one shape per bucket).
+    w_loc = next_pow2(max(len(s.words) for s in dcg.shards) + 1, 8)
+    words = np.zeros(Pn * w_loc, dtype=np.uint32)
+    wstart = np.zeros(Pn * n_loc, dtype=idt)
+    width = np.ones(Pn * n_loc, dtype=idt)
+    deg = np.zeros(Pn * n_loc, dtype=idt)
+    node_w = np.zeros(Pn * n_loc, dtype=idt)
+    has_edge_w = any(s.edge_w is not None for s in dcg.shards)
+    ew_stream = (
+        np.zeros(Pn * m_loc, dtype=idt) if has_edge_w
+        else np.zeros(Pn, dtype=idt)
+    )
+    ghost_sorted = np.full(Pn * g_loc, _GHOST_PAD, dtype=idt)
+    for s in range(Pn):
+        cg = dcg.shards[s]
+        n_s, m_s = cg.n, cg.m
+        words[s * w_loc : s * w_loc + len(cg.words)] = cg.words
+        wstart[s * n_loc : s * n_loc + n_s] = cg.word_start[:n_s].astype(idt)
+        width[s * n_loc : s * n_loc + n_s] = cg.width.astype(idt)
+        deg[s * n_loc : s * n_loc + n_s] = cg.degree.astype(idt)
+        node_w[s * n_loc : s * n_loc + n_s] = cg.node_w.astype(idt)
+        if has_edge_w:
+            ew_stream[s * m_loc : s * m_loc + m_s] = (
+                np.ones(m_s, dtype=idt) if cg.edge_w is None
+                else cg.edge_w.astype(idt)
+            )
+        gg = ghost_global[s]
+        ghost_sorted[s * g_loc : s * g_loc + len(gg)] = gg
+
+    shard_work = compute_shard_work(
+        send_idx, ghost_global,
+        owned_nodes=[
+            max(0, min((s + 1) * n_loc, dcg.n) - s * n_loc) for s in range(Pn)
+        ],
+        owned_edges=owned_edges, n_loc=n_loc, num_shards=Pn,
+    )
+
+    from ..utils import compile_stats
+
+    compile_stats.record(
+        "dist_compressed_bucket", statics=(Pn, n_loc, m_loc, w_loc, g_loc)
+    )
+    return DistDeviceCompressedView(
+        words=jnp.asarray(words),
+        wstart=jnp.asarray(wstart),
+        width=jnp.asarray(width),
+        deg=jnp.asarray(deg),
+        node_w=jnp.asarray(node_w),
+        edge_w_stream=jnp.asarray(ew_stream),
+        ghost_sorted=jnp.asarray(ghost_sorted),
+        send_idx=jnp.asarray(send_idx),
+        recv_map=jnp.asarray(recv_map),
+        ghost_global=tuple(ghost_global),
+        n=dcg.n, m=dcg.m, n_loc=n_loc, m_loc=m_loc, w_loc=w_loc,
+        g_loc=g_loc, cap_g=cap_g, num_shards=Pn, has_edge_w=has_edge_w,
+        shard_work=shard_work,
+    )
+
+
+def dist_device_decode_eligible(ctx) -> tuple:
+    """(eligible, reason) for the sharded device-decode envelope: the 32-bit
+    build with GLOBAL_LP dist clustering (HEM walks matchings, LOCAL_LP
+    needs shard-local labels for its exchange-free contraction — both stay
+    on the dense staging path)."""
+    from ..context import DistClusteringAlgorithm as DCA
+
+    if ctx.use_64bit_ids:
+        return False, "64-bit build"
+    if ctx.coarsening.dist_clustering != DCA.GLOBAL_LP:
+        return False, f"dist clusterer {ctx.coarsening.dist_clustering.value}"
+    return True, ""
+
+
+def build_dist_view_if_eligible(ctx, dcg):
+    """The dist partitioner's gate (PR 10's build_device_view_if_eligible
+    twin): a view when the ``device_decode`` knob + envelope allow it, else
+    None (dense staging fallback; ``finest`` warns, ``auto`` is silent)."""
+    import os
+
+    from ..graph.device_compressed import resolve_device_decode
+
+    mode = resolve_device_decode(ctx.compression)
+    if mode == "off":
+        return None
+    ok, reason = dist_device_decode_eligible(ctx)
+    if not ok:
+        requested = os.environ.get(
+            "KAMINPAR_TPU_DEVICE_DECODE", ""
+        ) or getattr(ctx.compression, "device_decode", "off")
+        if requested == "finest":
+            from ..utils.logger import Logger
+
+            Logger.warning(
+                f"compression.device_decode=finest requested but {reason}; "
+                "the dist tier falls back to the dense staging path"
+            )
+        return None
+    return build_dist_device_view(dcg)
+
+
+# -- decode-fused kernels ----------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def make_dist_cluster_round_compressed(mesh: Mesh, *, cap_q: int, m_loc: int,
+                                       has_edge_w: bool):
+    """Decode-fused global clustering round: per-shard gap-word decode feeds
+    the SHARED :func:`~kaminpar_tpu.dist.lp._cluster_round_body` (owner
+    auction admission), so the round is bit-identical to the dense one."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                  P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(), P()),
+    )
+    def round_fn(key, labels, node_w, words, wstart, width, deg, ew_stream,
+                 ghost_sorted, max_w, send_idx, recv_map):
+        eu, cl, ew = decode_shard_adjacency(
+            words, wstart, width, deg, ew_stream, ghost_sorted,
+            m_loc=m_loc, has_edge_w=has_edge_w,
+        )
+        return _cluster_round_body(
+            key, labels, node_w, eu, cl, ew, max_w, send_idx, recv_map,
+            cap_q=cap_q,
+        )
+
+    return jax.jit(round_fn)
+
+
+def dist_cluster_iterate_compressed(mesh, key, labels,
+                                    view: DistDeviceCompressedView, max_w, *,
+                                    num_rounds: int, cap_q: int | None = None):
+    """Clustering LP loop off the compressed view — the dense
+    :func:`~kaminpar_tpu.dist.lp.dist_cluster_iterate` drive (same
+    overflow-adaptive cap escalation, same counted per-attempt overflow
+    readback), with decode fused into each round's program."""
+    n_loc = view.n_loc
+    if cap_q is None:
+        cap_q = min(
+            next_pow2(max(64, 2 * n_loc // max(view.num_shards, 1)), 8), n_loc
+        )
+    fn = make_dist_cluster_round_compressed(
+        mesh, cap_q=cap_q, m_loc=view.m_loc, has_edge_w=view.has_edge_w
+    )
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        while True:
+            out, moved, ovf = fn(
+                jax.random.fold_in(key, i), labels, view.node_w, view.words,
+                view.wstart, view.width, view.deg, view.edge_w_stream,
+                view.ghost_sorted, max_w, view.send_idx, view.recv_map,
+            )
+            ovf_h = int(sync_stats.pull(ovf, shards=view.num_shards))
+            if ovf_h == 0 or cap_q >= n_loc:
+                break
+            cap_q = min(cap_q * 2, n_loc)
+            fn = make_dist_cluster_round_compressed(
+                mesh, cap_q=cap_q, m_loc=view.m_loc,
+                has_edge_w=view.has_edge_w,
+            )
+        labels = out
+        total = total + moved
+    return labels, total
+
+
+@lru_cache(maxsize=None)
+def make_dist_lp_round_compressed(mesh: Mesh, *, num_labels: int, m_loc: int,
+                                  has_edge_w: bool,
+                                  external_only: bool = False,
+                                  num_chunks: int = 1, donate: bool = False):
+    """Decode-fused LP refinement round (shared
+    :func:`~kaminpar_tpu.dist.lp._refine_round_body`); with ``donate`` the
+    labels carry is released to XLA each round (drive loops rebind it)."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                  P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P()),
+    )
+    def round_fn(key, labels, node_w, words, wstart, width, deg, ew_stream,
+                 ghost_sorted, max_w, send_idx, recv_map, chunk, salt):
+        eu, cl, ew = decode_shard_adjacency(
+            words, wstart, width, deg, ew_stream, ghost_sorted,
+            m_loc=m_loc, has_edge_w=has_edge_w,
+        )
+        return _refine_round_body(
+            key, labels, node_w, eu, cl, ew, max_w, send_idx, recv_map,
+            chunk, salt, num_labels=num_labels, external_only=external_only,
+            num_chunks=num_chunks,
+        )
+
+    return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
+
+
+def dist_lp_iterate_compressed(mesh, key, labels,
+                               view: DistDeviceCompressedView, max_w, *,
+                               num_labels: int, num_rounds: int,
+                               external_only: bool = False,
+                               num_chunks: int = 1, donate: bool = False):
+    """LP refinement loop off the compressed view (the dense
+    :func:`~kaminpar_tpu.dist.lp.dist_lp_iterate` drive, decode fused)."""
+    fn = make_dist_lp_round_compressed(
+        mesh, num_labels=num_labels, m_loc=view.m_loc,
+        has_edge_w=view.has_edge_w, external_only=external_only,
+        num_chunks=num_chunks, donate=donate,
+    )
+    total = jnp.int32(0)
+    for i in range(num_rounds):
+        for c in range(num_chunks):
+            labels, moved = fn(
+                jax.random.fold_in(key, i * num_chunks + c), labels,
+                view.node_w, view.words, view.wstart, view.width, view.deg,
+                view.edge_w_stream, view.ghost_sorted, max_w, view.send_idx,
+                view.recv_map, jnp.int32(c), jnp.int32(i),
+            )
+            total = total + moved
+    return labels, total
+
+
+# -- decode-fused contraction stage (S2) -------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "n_loc", "n_loc_c", "cap_q", "m_loc",
+                     "has_edge_w"),
+)
+def _s2c(mesh, labels, cmap_own, cw_own, words, wstart, width, deg, ew_stream,
+         ghost_sorted, send_idx, recv_map, *, n_loc: int, n_loc_c: int,
+         cap_q: int, m_loc: int, has_edge_w: bool):
+    """Compressed twin of contraction._s2: decode this shard's adjacency
+    in-trace, then run the shared S2 core (owner queries + routing).  The
+    decoded edge arrays are XLA transients of ONE fused program — the dense
+    slices never become resident buffers."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS),) * 11,
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                   P(AXIS), P(AXIS), P(AXIS), P()),
+    )
+    def body(labels_loc, cmap_own_loc, cw_own_loc, w_, ws_, wd_, dg_, ews_,
+             gs_, sidx, rmap):
+        eu, cl, ew = decode_shard_adjacency(
+            w_, ws_, wd_, dg_, ews_, gs_, m_loc=m_loc, has_edge_w=has_edge_w,
+        )
+        return _s2_core(
+            labels_loc, cmap_own_loc, cw_own_loc, eu, cl, ew, sidx, rmap,
+            n_loc=n_loc, n_loc_c=n_loc_c, cap_q=cap_q,
+        )
+
+    return body(labels, cmap_own, cw_own, words, wstart, width, deg,
+                ew_stream, ghost_sorted, send_idx, recv_map)
+
+
+def contract_dist_compressed(mesh: Mesh, view: DistDeviceCompressedView,
+                             labels, cap_q: int | None = None):
+    """Contract a distributed clustering straight off the compressed view.
+
+    The drive is the dense ``contract_dist_clustering`` step for step (same
+    counted pulls, same overflow escalation); only S2 — the one stage that
+    touches the adjacency — decodes in-kernel.  S3/S4 operate on the routed
+    coarse-edge buffers and the shared host assembly builds the coarse
+    DistGraph, which is DENSE (coarse levels shrink geometrically; the
+    compressed tier is the finest level's problem, exactly as in PR 10)."""
+    Pn = view.num_shards
+    n_loc = view.n_loc
+    if cap_q is None:
+        cap_q = min(next_pow2(max(64, 2 * n_loc // Pn), 8), n_loc)
+
+    while True:
+        n_c, cw_own, cmap_own, ovf = _s1(
+            mesh, labels, view.node_w, n_loc=n_loc, cap_q=cap_q
+        )
+        s1_stats = sync_stats.pull(jnp.stack([n_c, ovf]), shards=Pn)
+        if int(s1_stats[1]) == 0 or cap_q >= n_loc:
+            break
+        cap_q = min(cap_q * 2, n_loc)
+    n_c = int(s1_stats[0])
+    n_loc_c = next_pow2((n_c + Pn) // Pn, 8)
+
+    cap_q2 = cap_q
+    while True:
+        (coarse_of, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts,
+         ovf2) = _s2c(
+            mesh, labels, cmap_own, cw_own, view.words, view.wstart,
+            view.width, view.deg, view.edge_w_stream, view.ghost_sorted,
+            view.send_idx, view.recv_map,
+            n_loc=n_loc, n_loc_c=n_loc_c, cap_q=cap_q2, m_loc=view.m_loc,
+            has_edge_w=view.has_edge_w,
+        )
+        ovf2_h = int(sync_stats.pull(ovf2, shards=Pn))
+        if ovf2_h == 0 or cap_q2 >= n_loc + view.g_loc:
+            break
+        cap_q2 = min(cap_q2 * 2, n_loc + view.g_loc)
+
+    counts_h, wcounts_h = sync_stats.pull(counts, wcounts, shards=Pn)
+    cap = next_pow2(int(counts_h.max()), 8)
+    cap_w = next_pow2(int(wcounts_h.max()), 8)
+
+    agg_u, agg_v, agg_w, m_c_loc, node_w_c = _s3(
+        mesh, s_cu, s_cv, s_w, counts, w_keys, w_vals, wcounts,
+        num_shards=Pn, cap=cap, cap_w=cap_w, n_loc_c=n_loc_c,
+    )
+    m_c_loc = sync_stats.pull(m_c_loc, shards=Pn)
+    m_loc_c = next_pow2(int(m_c_loc.max()), 8)
+    m_loc_c = min(m_loc_c, Pn * cap)
+    edge_u_g, col_g, edge_w_c = _s4(mesh, agg_u, agg_v, agg_w, m_loc_c=m_loc_c)
+
+    coarse = _assemble_coarse(
+        edge_u_g, col_g, edge_w_c, node_w_c, m_c_loc, n_c,
+        n_loc_c=n_loc_c, m_loc_c=m_loc_c, num_shards=Pn,
+    )
+    return coarse, coarse_of, n_c
+
+
+# -- dense materialization (one sharded decode dispatch) ---------------------
+
+
+@lru_cache(maxsize=None)
+def _make_materialize(mesh: Mesh, *, m_loc: int, has_edge_w: bool):
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS),) * 6,
+        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def decode_fn(words, wstart, width, deg, ew_stream, ghost_sorted):
+        return decode_shard_adjacency(
+            words, wstart, width, deg, ew_stream, ghost_sorted,
+            m_loc=m_loc, has_edge_w=has_edge_w,
+        )
+
+    return jax.jit(decode_fn)
+
+
+def materialize_dist_graph(mesh: Mesh,
+                           view: DistDeviceCompressedView) -> DistGraph:
+    """Decode the dense :class:`DistGraph` from the view in ONE sharded
+    device dispatch — zero blocking transfers (every scalar a later phase
+    needs rides the view's host metadata), zero host decompress.  Used at
+    uncoarsening for the refiners that stay dense (balancer/CLP/JET) and
+    for replicate-to-host when the coarsest level is still compressed."""
+    eu, cl, ew = _make_materialize(
+        mesh, m_loc=view.m_loc, has_edge_w=view.has_edge_w
+    )(view.words, view.wstart, view.width, view.deg, view.edge_w_stream,
+      view.ghost_sorted)
+    return DistGraph(
+        node_w=view.node_w,
+        edge_u=eu,
+        col_loc=cl,
+        edge_w=ew,
+        send_idx=view.send_idx,
+        recv_map=view.recv_map,
+        ghost_global=view.ghost_global,
+        n=view.n,
+        m=view.m,
+        n_loc=view.n_loc,
+        m_loc=view.m_loc,
+        g_loc=view.g_loc,
+        cap_g=view.cap_g,
+        num_shards=view.num_shards,
+        shard_work=view.shard_work,
+    )

@@ -176,7 +176,37 @@ class DKaMinPar:
                 "(jax.config.update('jax_enable_x64', True))"
             )
         dtype = np.int64 if ctx.use_64bit_ids else np.int32
-        dg = distribute_graph(graph, P, dtype=dtype)
+
+        # Compressed staging + device residency (round 15): with
+        # ``compression.enabled`` the input is gap-packed per shard before
+        # anything m-sized exists host-side, and under ``device_decode``
+        # (same knob as the shm tier — terapart presets engage both) the
+        # per-shard streams become the *resident* finest-level adjacency on
+        # the mesh: LP clustering, contraction S2, and the finest LP
+        # refinement pass decode in-kernel inside shard_map, and
+        # ``decompress_arrays`` is never called past the view build.
+        from .compressed import DistributedCompressedGraph, compress_distributed
+        from .device_compressed import build_dist_view_if_eligible
+
+        dcg = None
+        if isinstance(graph, DistributedCompressedGraph):
+            dcg = graph
+        elif ctx.compression.enabled and not ctx.use_64bit_ids:
+            dcg = compress_distributed(graph, P)
+        if dcg is not None:
+            cb_since = sync_stats.phase_count("dist_compressed_build")
+            with scoped_timer("dist_compressed_build"):
+                view = build_dist_view_if_eligible(ctx, dcg)
+            # View build = one host decode per shard for the ghost routing
+            # + host packing + device puts: ZERO blocking device->host
+            # transfers (the memory win must not be bought with hidden
+            # syncs).  No-op unless enable_budget_checks armed it.
+            sync_stats.assert_phase_budget(
+                "dist_compressed_build", 0, since=cb_since
+            )
+            dg = view if view is not None else dcg.to_dist_graph(dtype=dtype)
+        else:
+            dg = distribute_graph(graph, P, dtype=dtype)
 
         # Per-shard load table — the reference's aggregated dist timer rows
         # (kaminpar-dist/timer.cc:106-173); see dist/shard_stats.py for why
@@ -238,10 +268,26 @@ class DKaMinPar:
                     )
                 if algo in (DCA.GLOBAL_LP, DCA.LOCAL_GLOBAL_LP,
                             DCA.GLOBAL_HEM_LP):
-                    lab, _ = dist_cluster_iterate(
-                        self.mesh, RandomState.next_key(), lab, cur,
-                        jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
-                    )
+                    if getattr(cur, "is_compressed_view", False):
+                        # Decode-fused clustering off the resident per-shard
+                        # gap streams (round 15).  The view only exists
+                        # under the GLOBAL_LP envelope, the drive consumes
+                        # the same key and the decoded adjacency is
+                        # bit-identical to the dense slices — so this
+                        # branch and the dense one produce identical labels.
+                        from .device_compressed import (
+                            dist_cluster_iterate_compressed,
+                        )
+
+                        lab, _ = dist_cluster_iterate_compressed(
+                            self.mesh, RandomState.next_key(), lab, cur,
+                            jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
+                        )
+                    else:
+                        lab, _ = dist_cluster_iterate(
+                            self.mesh, RandomState.next_key(), lab, cur,
+                            jnp.asarray(max_cw, cur.dtype), num_rounds=rounds,
+                        )
                 if algo == DCA.LOCAL_LP:
                     # shard-local clusters never migrate: the exchange-free
                     # local contraction (local_contraction.cc role) applies
@@ -290,6 +336,10 @@ class DKaMinPar:
             since=coarsen_since, shards=P,
             count_since=coarsen_count_since,
         )
+        # The coarsest may still be the compressed view (tiny inputs /
+        # early convergence): replicate-to-host and the dense refiners need
+        # the dense DistGraph — ONE sharded decode dispatch, zero pulls.
+        cur, cur_view = self._materialize_if_view(cur)
 
         # -- initial partitioning: replicate coarsest -> shm pipeline ------
         # Deep scheme (else-branch below): the coarsest carries only
@@ -437,7 +487,7 @@ class DKaMinPar:
             t_lvl = rec._now_us() if rec is not None else 0.0
             part_dev, cur_shard = shard_arrays(self.mesh, cur, jnp.asarray(part))
             part_dev, cur_k = self._extend_and_refine(
-                part_dev, cur_shard, cur_k, k, final_bw
+                part_dev, cur_shard, cur_k, k, final_bw, view=cur_view
             )
             uncoarsen_levels += 1
             probes.dist_uncoarsening_level(
@@ -451,20 +501,26 @@ class DKaMinPar:
             while self.hierarchy:
                 level = self.hierarchy.pop()
                 t_lvl = rec._now_us() if rec is not None else 0.0
+                # A compressed finest level stores only the view in the
+                # hierarchy; the dense graph the balancer/CLP/JET refiners
+                # need is decoded here in one sharded dispatch (zero
+                # pulls), while the LP refinement pass below runs straight
+                # off the view's streams.
+                level_graph, lvl_view = self._materialize_if_view(level.graph)
                 part_dev = project_partition_up(
                     self.mesh, level.coarse_of, part_dev,
                     n_loc_c=level.coarse_n_loc,
                 )
                 part_dev, cur_k = self._extend_and_refine(
-                    part_dev, level.graph, cur_k, k, final_bw
+                    part_dev, level_graph, cur_k, k, final_bw, view=lvl_view
                 )
                 uncoarsen_levels += 1
                 probes.dist_uncoarsening_level(
-                    level=len(self.hierarchy), n=level.graph.n,
-                    m=level.graph.m, k=cur_k, shards=P,
+                    level=len(self.hierarchy), n=level_graph.n,
+                    m=level_graph.m, k=cur_k, shards=P,
                 )
                 self._shard_level_spans(
-                    rec, "dist_uncoarsening_level", t_lvl, level.graph,
+                    rec, "dist_uncoarsening_level", t_lvl, level_graph,
                     level=len(self.hierarchy),
                 )
 
@@ -488,9 +544,13 @@ class DKaMinPar:
             since=getattr(self, "_refine_since", 0), shards=P,
             count_since=getattr(self, "_refine_count_since", 0),
         )
-        if Logger.level.value >= OutputLevel.EXPERIMENT.value:
+        if Logger.level.value >= OutputLevel.EXPERIMENT.value and isinstance(
+            graph, CSRGraph
+        ):
             # (dist_edge_cut computes the identical value on device — used
-            # when the graph only exists sharded; here the host copy is free)
+            # when the graph only exists sharded; here the host copy is free.
+            # Compressed inputs skip the host cut: decompressing the whole
+            # graph just for a log line would defeat the staging tier.)
             cut = metrics.edge_cut(graph, out)
             Logger.log(
                 f"dist RESULT cut={cut} k={k} n={graph.n} shards={P}",
@@ -498,8 +558,26 @@ class DKaMinPar:
             )
         return out
 
+    def _materialize_if_view(self, g):
+        """(dense graph, view-or-None) for a hierarchy level: a compressed
+        view is decoded into the dense DistGraph in ONE sharded device
+        dispatch under its own ``dist_compressed_decode`` phase with a
+        ZERO blocking-transfer budget asserted in-pipeline (round 15) —
+        no host decompress, no readbacks.  Dense levels pass through."""
+        if not getattr(g, "is_compressed_view", False):
+            return g, None
+        from .device_compressed import materialize_dist_graph
+
+        cd_since = sync_stats.phase_count("dist_compressed_decode")
+        with scoped_timer("dist_compressed_decode"):
+            dense = materialize_dist_graph(self.mesh, g)
+        sync_stats.assert_phase_budget(
+            "dist_compressed_decode", 0, since=cd_since
+        )
+        return dense, g
+
     def _extend_and_refine(self, part_dev, dgraph: DistGraph, cur_k: int, k: int,
-                           final_bw: np.ndarray):
+                           final_bw: np.ndarray, view=None):
         """Extend the partition toward k for this level's size, then refine.
 
         Reference: dist deep_multilevel.cc extend_partition (:208-311) —
@@ -559,22 +637,30 @@ class DKaMinPar:
             intermediate_block_weights(np.asarray(final_bw, dtype=np.int64), cur_k),  # kpt: ignore[sync-discipline] — final_bw is host np
             dtype=dgraph.dtype,
         )
-        part_dev = self._refine(part_dev, dgraph, cap, cur_k)
+        part_dev = self._refine(part_dev, dgraph, cap, cur_k, view=view)
         return part_dev, cur_k
 
-    def _refine(self, part, dgraph: DistGraph, cap, k: int):
+    def _refine(self, part, dgraph: DistGraph, cap, k: int, view=None):
         """Balance → LP, the reference's refiner pipeline order
         (dist factories.cc:95-131: NodeBalancer runs before LP/CLP/JET).
         Runs under its own ``dist_refinement`` phase so the balancer/LP
         convergence pulls budget separately from the uncoarsening spine."""
         self._refine_calls = getattr(self, "_refine_calls", 0) + 1
         with scoped_timer("dist_refinement"):
-            return self._refine_body(part, dgraph, cap, k)
+            return self._refine_body(part, dgraph, cap, k, view=view)
 
-    def _refine_body(self, part, dgraph: DistGraph, cap, k: int):
+    def _refine_body(self, part, dgraph: DistGraph, cap, k: int, view=None):
+        # Round carries are donated throughout (round 15, the SNIPPETS
+        # [1]-[3] donation pattern): every drive below rebinds its labels
+        # output (`x = fn(x, ...)`), so each round's input buffer is
+        # released to XLA the moment its output exists — across level
+        # boundaries the previous level's projected partition is freed as
+        # this level's refinement proceeds, instead of accumulating one
+        # (P*n_loc,) buffer per round per level.
         part, dgraph = shard_arrays(self.mesh, dgraph, part)
         part, feasible = dist_balance(
-            self.mesh, RandomState.next_key(), part, dgraph, cap, k=k
+            self.mesh, RandomState.next_key(), part, dgraph, cap, k=k,
+            donate=True,
         )
         if not feasible:
             Logger.warning(
@@ -587,29 +673,46 @@ class DKaMinPar:
             MoveExecutionStrategy.BEST_MOVES,
             MoveExecutionStrategy.LOCAL_MOVES,
         ):
-            from .lp import dist_lp_round_best, dist_lp_round_local
+            from .lp import make_dist_lp_round_best
 
-            round_fn = (
-                dist_lp_round_best
-                if self.ctx.refinement.dist_move_execution
-                == MoveExecutionStrategy.BEST_MOVES
-                else dist_lp_round_local
+            fn = make_dist_lp_round_best(
+                self.mesh, num_labels=k,
+                eager=self.ctx.refinement.dist_move_execution
+                == MoveExecutionStrategy.LOCAL_MOVES,
+                donate=True,
             )
             out = part
             for _ in range(self.ctx.refinement.lp.num_iterations):
-                out, moved = round_fn(
-                    self.mesh, RandomState.next_key(), out, dgraph, cap,
-                    num_labels=k,
+                out, moved = fn(
+                    RandomState.next_key(), out, dgraph.node_w, dgraph.edge_u,
+                    dgraph.col_loc, dgraph.edge_w, cap, dgraph.send_idx,
+                    dgraph.recv_map,
                 )
                 # Counted per-round convergence readback (round 13).
                 if int(sync_stats.pull(moved, shards=dgraph.num_shards)) == 0:
                     break
+        elif view is not None:
+            # Finest compressed level (round 15): the LP refinement pass
+            # decodes the adjacency in-kernel off the view's resident
+            # streams — bit-identical to the dense rounds (the decode
+            # reproduces the dense slices exactly and the shared round body
+            # does the rest), same key consumption, same pull structure.
+            from .device_compressed import dist_lp_iterate_compressed
+
+            out, _ = dist_lp_iterate_compressed(
+                self.mesh, RandomState.next_key(), part, view, cap,
+                num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
+                external_only=False,
+                num_chunks=max(self.ctx.refinement.dist_num_chunks, 1),
+                donate=True,
+            )
         else:
             out, _ = dist_lp_iterate(
                 self.mesh, RandomState.next_key(), part, dgraph, cap,
                 num_labels=k, num_rounds=self.ctx.refinement.lp.num_iterations,
                 external_only=False,
                 num_chunks=max(self.ctx.refinement.dist_num_chunks, 1),
+                donate=True,
             )
 
         if RefinementAlgorithm.CLP in self.ctx.refinement.algorithms:
@@ -620,6 +723,7 @@ class DKaMinPar:
                 num_labels=k,
                 num_iterations=self.ctx.refinement.clp.num_iterations,
                 allow_tie_moves=self.ctx.refinement.clp.allow_tie_moves,
+                donate=True,
             )
         if RefinementAlgorithm.JET in self.ctx.refinement.algorithms:
             from .jet import dist_jet_iterate

@@ -94,7 +94,9 @@ def _funnel_unpack(words, w0, bit_in_word, wd):
     """Extract the ``wd``-bit zig-zag value starting at ``bit_in_word`` of
     word ``w0`` and return the signed gap — the per-edge shift/mask core.
     32-bit only (no uint64), so the math lowers identically with and
-    without jax x64."""
+    without jax x64.  Shared with the dist tier's per-shard in-trace
+    decode (dist/device_compressed.decode_shard_adjacency, round 15) —
+    any change here must keep the signed shard-relative-gap case exact."""
     s0 = jnp.clip(w0, 0, words.shape[0] - 2)
     sh = bit_in_word.astype(jnp.uint32)
     lo = words[s0]

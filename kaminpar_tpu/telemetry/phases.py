@@ -76,6 +76,14 @@ AUX_PHASES = (
     # at final uncoarsening (one decode dispatch, zero pulls — asserted).
     "compressed_build",
     "compressed_decode",
+    # Sharded compressed tier (round 15, ISSUE 11; dist/device_compressed.py):
+    # the dist twins of the two phases above — per-shard view construction
+    # (one host decode per shard for ghost routing + device puts, zero
+    # pulls — asserted with a 0 budget in dist/partitioner.py) and the
+    # per-level dense materialization at uncoarsening (one sharded decode
+    # dispatch, zero pulls — asserted).
+    "dist_compressed_build",
+    "dist_compressed_decode",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

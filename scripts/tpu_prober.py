@@ -101,6 +101,15 @@ def child_attempt() -> None:
     # on TPU, unlike the CPU fallback) becomes a measured number.
     os.environ.setdefault("KPTPU_BENCH_COMPRESS", "1")
     os.environ.setdefault("KPTPU_BENCH_COMPRESS_SCALE", "16")
+    # Sharded deep A/B (ISSUE 11) rides run_benchmark's phase 5 in its own
+    # child: single-device vs P-shard dense vs P-shard compressed-resident.
+    # On a multi-chip host set KPTPU_BENCH_SHARD_NATIVE=1 to measure the
+    # real mesh; single-chip windows carry the virtual-CPU dryrun (the
+    # bit-identity + resident-bytes record is backend-exact either way).
+    os.environ.setdefault("KPTPU_BENCH_SHARD", "1")
+    os.environ.setdefault("KPTPU_BENCH_SHARD_SCALE", "12")
+    if len(devs) >= 8:
+        os.environ.setdefault("KPTPU_BENCH_SHARD_NATIVE", "1")
     # Run telemetry (ISSUE 5): the full-partition phase records the unified
     # trace on-silicon; its summary (trace path, per-level quality rows,
     # HBM watermark) rides the salvaged record into TPU_RESULT.json and

@@ -263,6 +263,39 @@ def test_distributed_compressed_graph_roundtrip():
     )
 
 
+def test_to_dist_graph_decodes_each_shard_once():
+    """Round-15 satellite: the staging path decodes every shard exactly ONCE
+    (the original two-pass form decoded each shard twice — once for ghost
+    routing, once for the device slices), and the single-pass layout is
+    byte-identical to distribute_graph's."""
+    import kaminpar_tpu.graph.compressed as gcomp
+    from kaminpar_tpu.dist.compressed import compress_distributed
+    from kaminpar_tpu.dist.graph import distribute_graph
+    from kaminpar_tpu.graph import generators
+
+    g = generators.rmat_graph(9, 8, seed=5)
+    P = 8
+    dcg = compress_distributed(g, P)
+    calls = {"n": 0}
+    orig = gcomp.CompressedGraph.decompress_arrays
+
+    def counting(self):
+        calls["n"] += 1
+        return orig(self)
+
+    gcomp.CompressedGraph.decompress_arrays = counting
+    try:
+        dg_c = dcg.to_dist_graph()
+    finally:
+        gcomp.CompressedGraph.decompress_arrays = orig
+    assert calls["n"] == P, calls
+    dg_r = distribute_graph(g, P)
+    for f in ("node_w", "edge_u", "col_loc", "edge_w", "send_idx", "recv_map"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dg_c, f)), np.asarray(getattr(dg_r, f)), f
+        )
+
+
 def test_distributed_compressed_pipeline():
     """Full dist pipeline over a compressed-built DistGraph."""
     import jax

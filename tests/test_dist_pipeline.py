@@ -359,6 +359,236 @@ def test_dist_deep_extends_partition():
     assert (bw <= per).all(), bw
 
 
+# -- sharded compressed tier (round 15, ISSUE 11) ----------------------------
+
+
+def _compress_ctx(device_decode, cl=40, seed=3):
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.contraction_limit = cl
+    ctx.seed = seed
+    ctx.compression.enabled = device_decode is not None
+    if device_decode is not None:
+        ctx.compression.device_decode = device_decode
+    return ctx
+
+
+def test_dist_compressed_view_layout_matches_dense():
+    """Layer-1 identity: the staged dense DistGraph (to_dist_graph), the
+    plain distribute_graph layout, and the device view's one-dispatch
+    materialization agree array for array — pad conventions, ghost slot
+    numbering, routing, and the layout scalars."""
+    from kaminpar_tpu.dist.compressed import compress_distributed
+    from kaminpar_tpu.dist.device_compressed import (
+        build_dist_device_view,
+        materialize_dist_graph,
+    )
+
+    mesh = _mesh()
+    g = generators.rmat_graph(9, 8, seed=5)
+    dg = distribute_graph(g, mesh.size)
+    dcg = compress_distributed(g, mesh.size)
+    staged = dcg.to_dist_graph()
+    view = build_dist_device_view(dcg)
+    dense = materialize_dist_graph(mesh, view)
+    assert (view.n_loc, view.m_loc, view.g_loc, view.cap_g) == (
+        dg.n_loc, dg.m_loc, dg.g_loc, dg.cap_g
+    )
+    for other in (staged, dense):
+        for f in ("node_w", "edge_u", "col_loc", "edge_w", "send_idx",
+                  "recv_map"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dg, f)), np.asarray(getattr(other, f)), f
+            )
+    assert view.shard_work == dg.shard_work
+    # the compressed tier actually shrinks the resident adjacency
+    assert view.resident_bytes() < view.dense_resident_bytes()
+
+
+@pytest.mark.parametrize("P", [8])
+def test_dist_compressed_pipeline_bit_identity(P):
+    """Acceptance (ISSUE 11): the full sharded deep pipeline off the
+    device-resident per-shard compressed streams is bit-identical to the
+    dense dist path at the same config — with per-shard budgets + the
+    implicit-sync tripwire ARMED, the new dist_compressed_* phases pulling
+    ZERO transfers, and ``decompress_arrays`` never called past the view
+    build (the no-host-decompress contract).
+
+    Only the full 8-device mesh runs in-process: the P=1/2 legs each
+    compile a full extra set of dist shard_map specializations (programs
+    key on the mesh), and on a box still at the default
+    ``vm.max_map_count`` (65530) the extra JIT mappings push the suite
+    process over the limit — a later compile (a serve engine thread ~70
+    tests downstream) then segfaults in LLVM (the round-5 box gotcha,
+    .claude/skills/verify; bisected to exactly these legs, confirmed by
+    the suite passing with the sysctl raised).  The P ∈ {1, 2} coverage
+    lives in ``test_dist_compressed_bit_identity_small_meshes`` below,
+    which gives each sub-mesh a fresh process — correct under either
+    sysctl setting."""
+    import kaminpar_tpu.graph.compressed as gcomp
+    from kaminpar_tpu.utils import sync_stats
+
+    devs = jax.devices()
+    if len(devs) < P:
+        pytest.skip(f"need {P} devices")
+    mesh = Mesh(np.array(devs[:P]), ("nodes",))
+    g = generators.rmat_graph(9, 8, seed=7)
+    k = 4
+
+    part_dense = DKaMinPar(mesh, _compress_ctx(None)).compute_partition(g, k=k)
+
+    calls = {"n": 0}
+    orig = gcomp.CompressedGraph.decompress_arrays
+
+    def counting(self):
+        calls["n"] += 1
+        return orig(self)
+
+    from kaminpar_tpu.utils.timer import Timer
+
+    Timer.reset_global()
+    sync_stats.reset()
+    sync_stats.enable_budget_checks(True)
+    gcomp.CompressedGraph.decompress_arrays = counting
+    try:
+        with sync_stats.tripwire():
+            part_comp = DKaMinPar(
+                mesh, _compress_ctx("finest")
+            ).compute_partition(g, k=k)
+    finally:
+        gcomp.CompressedGraph.decompress_arrays = orig
+        sync_stats.enable_budget_checks(False)
+    np.testing.assert_array_equal(part_dense, part_comp)
+    # one decode per shard at view build (ghost routing), none afterwards
+    assert calls["n"] == P, calls
+    # both compressed phases OPENED (timer tree) yet pulled ZERO transfers
+    # (a phase with no pulls never enters the sync snapshot — that absence
+    # IS the zero-transfer contract, witnessed against the open scope)
+    timer = Timer.global_()
+    assert timer.phase_seconds("dist_compressed_build") is not None
+    assert timer.phase_seconds(
+        "dist_uncoarsening", "dist_compressed_decode"
+    ) is not None or timer.phase_seconds("dist_compressed_decode") is not None
+    phases = sync_stats.snapshot()["phases"]
+    for phase in ("dist_compressed_build", "dist_compressed_decode"):
+        assert phases.get(phase, {"count": 0})["count"] == 0, (phase, phases)
+
+
+@pytest.mark.parametrize("P", [1, 2])
+def test_dist_compressed_bit_identity_small_meshes(P):
+    """The P ∈ {1, 2} legs of the bit-identity acceptance matrix, each in a
+    FRESH subprocess: their per-mesh shard_map specializations would spend
+    the suite process's memory-map budget (see the P=8 test's docstring),
+    and a process boundary keeps tier-1 immune to the box's
+    ``vm.max_map_count`` setting.  The child re-runs the exact in-process
+    check: dense == compressed, one decompress per shard, budgets +
+    tripwire armed."""
+    import os
+    import subprocess
+    import sys
+
+    code = f"""
+from kaminpar_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(8)
+import jax, numpy as np
+from jax.sharding import Mesh
+import kaminpar_tpu.graph.compressed as gcomp
+from kaminpar_tpu.dist.partitioner import DKaMinPar
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.utils import sync_stats
+
+P = {P}
+mesh = Mesh(np.array(jax.devices()[:P]), ("nodes",))
+g = generators.rmat_graph(9, 8, seed=7)
+
+def ctx(compress, mode):
+    c = create_context_by_preset_name("default")
+    c.coarsening.contraction_limit = 40
+    c.seed = 3
+    c.compression.enabled = compress
+    c.compression.device_decode = mode
+    return c
+
+part_dense = DKaMinPar(mesh, ctx(False, "off")).compute_partition(g, k=4)
+calls = dict(n=0)
+orig = gcomp.CompressedGraph.decompress_arrays
+def counting(self):
+    calls["n"] += 1
+    return orig(self)
+gcomp.CompressedGraph.decompress_arrays = counting
+sync_stats.enable_budget_checks(True)
+with sync_stats.tripwire():
+    part_comp = DKaMinPar(mesh, ctx(True, "finest")).compute_partition(g, k=4)
+assert np.array_equal(part_dense, part_comp), "partition diverged"
+assert calls["n"] == P, calls
+print("IDENTICAL", P)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    assert f"IDENTICAL {P}" in out.stdout
+
+
+def test_dist_compressed_vs_single_device_deep():
+    """The sharded compressed path's quality tracks the single-device deep
+    pipeline at matching config (cut within the dist tier's usual 1.5x
+    envelope of the shm pipeline — the test_dist_nontoy bound)."""
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    mesh = _mesh()
+    g = generators.rmat_graph(9, 8, seed=7)
+    k = 4
+    part = DKaMinPar(mesh, _compress_ctx("finest")).compute_partition(g, k=k)
+    shm_ctx = _compress_ctx(None)
+    shm = KaMinPar(shm_ctx)
+    shm.set_graph(g)
+    shm_cut = metrics.edge_cut(g, shm.compute_partition(k, epsilon=0.03))
+    dist_cut = metrics.edge_cut(g, part)
+    assert dist_cut <= 1.5 * max(shm_cut, 1), (dist_cut, shm_cut)
+    w = np.bincount(part, weights=np.asarray(g.node_w), minlength=k)
+    limit = (1.03 * g.total_node_weight + k - 1) // k + g.max_node_weight
+    assert w.max() <= limit
+
+
+def test_dist_compressed_fallback_outside_envelope(capsys):
+    """Outside the envelope (HEM clustering) the view gate falls back to the
+    dense staging path — loudly under device_decode=finest — and the
+    pipeline still produces a valid partition off the staged graph."""
+    from kaminpar_tpu.context import DistClusteringAlgorithm
+
+    mesh = _mesh()
+    g = generators.rmat_graph(9, 8, seed=7)
+    ctx = _compress_ctx("finest")
+    ctx.coarsening.dist_clustering = DistClusteringAlgorithm.GLOBAL_HEM_LP
+    part = DKaMinPar(mesh, ctx).compute_partition(g, k=4)
+    assert "dense staging" in capsys.readouterr().err
+    assert part.shape == (g.n,)
+    assert part.min() >= 0 and part.max() < 4
+
+
+@pytest.mark.slow  # full dist pipeline x weighted input: tier-2
+def test_dist_compressed_weighted_bit_identity():
+    """Weighted graphs (non-uniform edge weights ride the uncompressed side
+    stream): compressed-vs-dense bit identity holds with the weight stream
+    engaged."""
+    mesh = _mesh()
+    g = generators.rmat_graph(10, 8, seed=11)  # rmat dedup sums weights > 1
+    assert int(np.asarray(g.edge_w).max()) > 1, "fixture lost its weights"
+    k = 8
+    part_dense = DKaMinPar(mesh, _compress_ctx(None)).compute_partition(g, k=k)
+    part_comp = DKaMinPar(
+        mesh, _compress_ctx("finest")
+    ).compute_partition(g, k=k)
+    np.testing.assert_array_equal(part_dense, part_comp)
+
+
 def test_dist_metrics_match_host():
     import numpy as np
 

@@ -250,6 +250,56 @@ def test_tools_trace_shards_summary(tmp_path, capsys):
     assert "shard lanes: (none" in capsys.readouterr().out
 
 
+# -- sharded compressed tier (round 15) ---------------------------------------
+
+
+def test_dist_compressed_phases_budgets_and_zero_collectives(tmp_path):
+    """Round-15 contracts for the new dist_compressed_* phases, checked on
+    the armed 8-device dryrun: (a) both phases record ZERO blocking
+    transfers and ZERO collectives (the view build is host packing +
+    device puts; the materialization is one local sharded decode — no
+    psum/all_to_all anywhere in either); (b) the armed compressed run
+    passes the same in-pipeline per-shard budgets as the dense pipeline
+    with the implicit-sync tripwire up; (c) re-running the already-traced
+    compressed programs adds nothing to the collective census."""
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    mesh = _mesh()
+    g = generators.rmat_graph(9, 8, seed=7)
+
+    def ctx():
+        c = create_context_by_preset_name("default")
+        c.coarsening.contraction_limit = 40
+        c.seed = 3
+        c.compression.enabled = True
+        c.compression.device_decode = "finest"
+        return c
+
+    sync_stats.reset()
+    collective_stats.reset()
+    sync_stats.enable_budget_checks(True)
+    try:
+        with telemetry.run(trace_out=str(tmp_path / "t.json")):
+            with sync_stats.tripwire():
+                part1 = DKaMinPar(mesh, ctx()).compute_partition(g, k=4)
+    finally:
+        sync_stats.enable_budget_checks(False)
+    phases = sync_stats.snapshot()["phases"]
+    for phase in ("dist_compressed_build", "dist_compressed_decode"):
+        # a zero-pull phase never enters the snapshot — its absence (or an
+        # all-zero row) is the contract; any transfer would materialize a row
+        row = phases.get(phase, {"count": 0, "implicit": 0})
+        assert row["count"] == 0 and row["implicit"] == 0, (phase, row)
+        assert collective_stats.phase_ops(phase) == {}, phase
+
+    # (c) a second identical run re-executes the same compiled programs:
+    # the trace-time census must not move, and the partition is stable.
+    before = collective_stats.snapshot()["count"]
+    part2 = DKaMinPar(mesh, ctx()).compute_partition(g, k=4)
+    assert collective_stats.snapshot()["count"] == before
+    np.testing.assert_array_equal(part1, part2)
+
+
 # -- shard work table ---------------------------------------------------------
 
 
