@@ -276,6 +276,11 @@ def run_full_phase(record: dict | None = None) -> dict:
     from kaminpar_tpu.utils import heap_profiler
     from kaminpar_tpu.utils.heap_profiler import HeapProfiler
 
+    # Executable census (ISSUE 12): armed for the bench so compile events
+    # attribute to their phases and warmup/AOT harvest sites populate —
+    # strictly host-side (zero transfers; tests assert neutrality).
+    if os.environ.get("KPTPU_BENCH_CENSUS", "1") == "1":
+        compile_stats.arm_executable_census()
     ip_pool.reset_pool_stats()
     RandomState.reseed(0)
     fgraph = rmat_graph(full_scale, edge_factor=16, seed=1)
@@ -330,6 +335,11 @@ def run_full_phase(record: dict | None = None) -> dict:
         "host_sync_count": sync_snap["count"],
         "host_sync_bytes": sync_snap["bytes"],
         "host_sync": sync_snap["phases"],
+        # Executable census + per-phase compile attribution (ISSUE 12):
+        # what the compiled programs WOULD do (XLA cost/memory analyses)
+        # and which phases paid the cold compiles.
+        "executable_census": compile_stats.executable_census_snapshot(),
+        "compile_by_phase": compile_stats.compile_by_phase_snapshot(),
     })
     # Telemetry summary (ISSUE 5): trace path + per-level quality rows +
     # the HBM watermark, embedded so BENCH_*.json / TPU_PROBE_LOG.jsonl
@@ -473,6 +483,13 @@ def run_serve_phase(record: dict | None = None) -> dict:
     k = int(os.environ.get("KPTPU_BENCH_SERVE_K", 8))
     base_n = min(int(os.environ.get("KPTPU_BENCH_SERVE_BASE_REQS", 6)), n_req)
 
+    from kaminpar_tpu.utils import compile_stats
+
+    if os.environ.get("KPTPU_BENCH_CENSUS", "1") == "1":
+        # Engine warmup harvests per-cell executable censuses when armed
+        # (ISSUE 12) — the serve record carries them below.
+        compile_stats.arm_executable_census()
+
     RandomState.reseed(0)
     graphs = [
         rmat_graph(scales[i % len(scales)], edge_factor=8, seed=100 + i)
@@ -597,6 +614,7 @@ def run_serve_phase(record: dict | None = None) -> dict:
             "lanestack_vs_pergraph"
         ),
         "serve_sweep": sweep,
+        "executable_census": compile_stats.executable_census_snapshot(),
     })
     print(json.dumps(record), flush=True)
     return record
@@ -1044,6 +1062,19 @@ def _run_child(timeout_s: float, extra_env: dict | None = None) -> tuple[dict | 
     headline record (or None) and an error string ('' = clean)."""
     env = dict(os.environ)
     env.update(extra_env or {})
+    # Flight recorder (ISSUE 12): every killable bench child heartbeats to
+    # its own sidecar with a stack dump armed just under the kill timeout,
+    # so a timeout kill yields a dossier (phase + stack tail) instead of a
+    # bare "killed after N s".  The sidecar env contract is single-sourced
+    # in telemetry/flight_recorder.child_sidecar_env (shared with the
+    # prober's run_attempt).
+    from kaminpar_tpu.telemetry import flight_recorder
+
+    phase_tag = (extra_env or {}).get("KPTPU_BENCH_PHASE", "bench")
+    fr_env, hb_path, stack_path = flight_recorder.child_sidecar_env(
+        os.path.join(REPO, f".bench_child_{phase_tag}"), timeout_s
+    )
+    env.update(fr_env)
     try:
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -1055,6 +1086,7 @@ def _run_child(timeout_s: float, extra_env: dict | None = None) -> tuple[dict | 
         )
     except Exception as exc:  # noqa: BLE001
         return None, f"{type(exc).__name__}: {exc}"[:500]
+    dossier = None
     try:
         out, errout = proc.communicate(timeout=timeout_s)
         err = ""
@@ -1067,9 +1099,19 @@ def _run_child(timeout_s: float, extra_env: dict | None = None) -> tuple[dict | 
             pass
         out, errout = proc.communicate()
         err = f"benchmark child killed after {timeout_s:.0f}s"
+        try:
+            dossier = flight_recorder.read_dossier(hb_path, stack_path)
+        except Exception:  # noqa: BLE001 — forensics must not mask the kill
+            dossier = None
+        if dossier is not None:
+            err += (f" (phase={dossier.get('phase')} "
+                    f"class={dossier.get('phase_class')})")
+    flight_recorder.cleanup_sidecars(hb_path, stack_path)
     rec = _salvage(out or "")
     if rec is not None and err:
         rec["note"] = err  # partial result: headline phase finished, later phase cut off
+        if dossier is not None:
+            rec["kill_dossier"] = dossier
         err = ""
     return rec, err
 
@@ -1154,6 +1196,14 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
 
 def main() -> None:
     if "--child" in sys.argv:
+        # Flight recorder (ISSUE 12): heartbeat + armed stack dump when the
+        # parent configured sidecars (bench _run_child and the prober do).
+        try:
+            from kaminpar_tpu.telemetry import flight_recorder
+
+            flight_recorder.arm_from_env()
+        except Exception:  # noqa: BLE001 — forensics must not break the child
+            pass
         phase = os.environ.get("KPTPU_BENCH_PHASE")
         if phase == "shard":
             # The 8-device CPU-mesh dryrun (ISSUE 11): force the virtual

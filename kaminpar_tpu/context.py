@@ -714,6 +714,16 @@ class ServeContext:
     # during startup warmup (kind="lanestack" warmup-report rows); empty
     # disables the pass (the per-graph warmup stays as is).
     warm_lanes: tuple = ()
+    # HBM admission preflight (ISSUE 12; telemetry/capacity.py): "auto"
+    # rejects a request whose predicted watermark exceeds the engine's
+    # ceiling when a ceiling is known (explicit override below, measured
+    # allocator limit, or the device-kind table — CPU without allocator
+    # stats has none, so "auto" passes everything there); "off" disables.
+    capacity_preflight: str = "auto"
+    # Explicit admission ceiling in bytes; 0 = derive (allocator bytes_limit
+    # when the backend exposes one, else the per-device-kind HBM table at
+    # the planner's headroom).  Tests pin small values to force rejection.
+    capacity_ceiling_bytes: int = 0
 
 
 @dataclass

@@ -20,6 +20,7 @@ from .batching import (
 from .engine import PartitionEngine, ServeFuture, ServeRequest, ServeResult
 from .lanestack import LaneStackReport, LaneStackUnsupported, run_lanestacked
 from .errors import (
+    CapacityError,
     DeadlineExceededError,
     EngineStoppedError,
     QueueFullError,
@@ -31,6 +32,7 @@ from .stats import ServeStats
 
 __all__ = [
     "BoundedServeQueue",
+    "CapacityError",
     "DeadlineExceededError",
     "EngineStoppedError",
     "LaneStackReport",

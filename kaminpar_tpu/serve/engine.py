@@ -41,6 +41,7 @@ import numpy as np
 from ..context import Context, ServeContext
 from .batching import ShapeCell, batched_metrics, pack_graphs, shape_cell
 from .errors import (
+    CapacityError,
     DeadlineExceededError,
     EngineStoppedError,
     QueueFullError,
@@ -225,6 +226,12 @@ class PartitionEngine:
         self._lanestack_failures = 0
         self._lanestack_broken = False
         self.warmup_report: List[dict] = []
+        # Admission-preflight ceiling (ISSUE 12): resolved lazily at start()
+        # — explicit override > measured allocator limit > device-kind
+        # table; None disables (no ceiling is knowable, e.g. CPU without
+        # allocator stats).
+        self._capacity_ceiling: Optional[int] = None
+        self._device_kind: str = ""
         self._ids = itertools.count(1)
         self._solver = None
         self._thread: Optional[threading.Thread] = None
@@ -259,6 +266,7 @@ class PartitionEngine:
             from ..utils import compile_stats
 
             compile_stats.enable_compile_time_tracking()
+            self._resolve_capacity_ceiling()
             if warmup:
                 self._warmup()
             self._running = True
@@ -267,6 +275,74 @@ class PartitionEngine:
             )
             self._thread.start()
         return self
+
+    def _resolve_capacity_ceiling(self) -> None:
+        """Resolve the admission-preflight ceiling (ISSUE 12): the explicit
+        ServeContext override, else the device allocator's measured
+        bytes_limit, else the per-device-kind HBM table
+        (telemetry/capacity.py) — None when nothing is knowable."""
+        from ..telemetry import capacity
+        from ..utils import heap_profiler
+
+        try:
+            import jax
+
+            self._device_kind = str(
+                getattr(jax.devices()[0], "device_kind", "")
+            )
+        except Exception:  # noqa: BLE001 — a dead backend resolves later
+            self._device_kind = ""
+        explicit = int(getattr(self.serve, "capacity_ceiling_bytes", 0) or 0)
+        if explicit > 0:
+            self._capacity_ceiling = explicit
+            return
+        limit = heap_profiler.memory_summary().get("bytes_limit")
+        if limit:
+            # bytes_limit is already the allocator's usable pool (XLA's
+            # reservation is taken off the top) — applying the planner's
+            # headroom again would double-discount vs the device-kind
+            # table path and HBM_BUDGET.md.
+            self._capacity_ceiling = int(limit)
+            return
+        self._capacity_ceiling = capacity.device_ceiling_bytes(
+            self._device_kind
+        )
+
+    def _capacity_preflight(self, graph, k: int) -> None:
+        """Reject a predicted-oversize request with :class:`CapacityError`
+        BEFORE it is queued (and long before anything compiles) — pure
+        host arithmetic over the graph's padded shape cell (ISSUE 12; the
+        first piece of the ROADMAP serve-fleet SLO-aware admission)."""
+        mode = str(
+            getattr(self.serve, "capacity_preflight", "auto")
+        ).strip().lower()
+        if mode == "off" or self._capacity_ceiling is None:
+            return
+        from ..telemetry import capacity
+        from ..utils.timer import scoped_timer
+
+        with scoped_timer("capacity_preflight"):
+            try:
+                capacity.preflight(
+                    graph, k,
+                    ceiling_bytes=self._capacity_ceiling,
+                    device_kind=self._device_kind,
+                    device_decode=(
+                        self.ctx.compression.enabled
+                        and str(self.ctx.compression.device_decode) != "off"
+                    ),
+                )
+            except CapacityError:
+                self.stats_.bump("rejected_capacity")
+                from ..telemetry import trace as ttrace
+
+                rec = ttrace.active()
+                if rec is not None:
+                    rec.instant(
+                        "serve.reject_capacity", k=int(k),
+                        ceiling_bytes=self._capacity_ceiling,
+                    )
+                raise
 
     def _warmup(self) -> None:
         """Trace/compile the executable set over warm_ladder x warm_ks by
@@ -302,7 +378,7 @@ class PartitionEngine:
                 self._solver.compute_partition(int(k), 0.03)
                 wall = time.perf_counter() - t0
                 after = compile_stats.compile_time_snapshot()
-                self.warmup_report.append({
+                row = {
                     "n": 1 << scale,
                     "k": int(k),
                     "n_bucket": cell.n_bucket,
@@ -312,7 +388,18 @@ class PartitionEngine:
                         after["backend_compile_s"] - before["backend_compile_s"], 3
                     ),
                     "trace_s": round(after["trace_s"] - before["trace_s"], 3),
-                })
+                }
+                if compile_stats.executable_census_armed():
+                    # Executable census of the cell (ISSUE 12): what the
+                    # warmed hot kernels WOULD do on silicon — flops/bytes
+                    # from cost_analysis, arg/out/temp/peak bytes from
+                    # memory_analysis — via shape-only lowering (no device
+                    # data, zero transfers; armed-only so unarmed warmups
+                    # pay nothing).
+                    census_row = self._harvest_cell_census(cell)
+                    if census_row:
+                        row["census"] = census_row
+                self.warmup_report.append(row)
                 self._note_warm(cell)
         self._warm_ip_pool(rung_graph)
         self._warm_lanestack(rung_graph)
@@ -327,6 +414,30 @@ class PartitionEngine:
         ]
         if execs:
             self.stats_.seed_service_time(float(np.mean(execs)))
+
+    def _harvest_cell_census(self, cell: ShapeCell) -> dict:
+        """Harvest the executable census of one warm shape cell via the
+        capacity planner's shared ``capacity_contraction|n,m`` registry key
+        (telemetry/capacity.harvest_contraction_cell) — the transient
+        dominator lowered + compiled from ``jax.ShapeDtypeStruct`` shapes,
+        so the planner and the warmup reuse each other's rows and one
+        executable is never compiled twice.  Pure host-side compiler
+        introspection — no device arrays exist, so the armed census adds
+        zero blocking transfers and zero collectives (asserted in
+        tests/test_capacity.py)."""
+        from ..telemetry import capacity
+
+        with self.runtime.activate():
+            row = capacity.harvest_contraction_cell(
+                int(cell.n_bucket), int(cell.m_bucket)
+            )
+        if not row:
+            return {}
+        return {
+            k: row[k]
+            for k in ("flops", "bytes_accessed", "temp_bytes", "peak_bytes")
+            if row.get(k) is not None
+        }
 
     def _warm_lanestack(self, rung_graph) -> None:
         """Precompile the lane-stacked pipeline per (rung, k, lane-count)
@@ -564,6 +675,7 @@ class PartitionEngine:
         if not self._running:
             raise EngineStoppedError("engine not started (call start())")
         self.stats_.bump("submitted")
+        self._capacity_preflight(graph, k)
         cell = shape_cell(graph, k)
         warm = (cell.n_bucket, int(k)) in self._warm_nk
         self.stats_.record_warm(warm)
@@ -942,11 +1054,15 @@ class PartitionEngine:
         serves this at ``/metrics``; scrape-friendly and dependency-free
         (telemetry/prometheus.py)."""
         from ..telemetry import prometheus
+        from ..utils import compile_stats
 
-        return prometheus.render(
-            self.stats_.prometheus_families(
-                queue_depth=len(self._queue),
-                running=self._running,
-                warm_cells=len(self._warm_cells),
-            )
+        families = self.stats_.prometheus_families(
+            queue_depth=len(self._queue),
+            running=self._running,
+            warm_cells=len(self._warm_cells),
         )
+        # Executable census families (ISSUE 12): per-cell flops / peak /
+        # temp bytes from XLA's own analyses, exported beside the serve
+        # metrics so operators scrape what each executable WOULD do.
+        families.extend(compile_stats.census_prometheus_families())
+        return prometheus.render(families)

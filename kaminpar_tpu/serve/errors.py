@@ -27,6 +27,29 @@ class QueueFullError(ServeError):
         )
 
 
+class CapacityError(ServeError):
+    """Admission preflight rejected the request: its predicted HBM
+    watermark exceeds the engine's per-device ceiling (ISSUE 12; see
+    telemetry/capacity.py).  Raised BEFORE the request is queued — nothing
+    was compiled or dispatched.  Carries the prediction so SLO-aware
+    routers can steer the request to a bigger device instead of retrying.
+    """
+
+    def __init__(self, predicted_bytes: int, ceiling_bytes: int,
+                 cell=(), device_kind: str = ""):
+        self.predicted_bytes = int(predicted_bytes)
+        self.ceiling_bytes = int(ceiling_bytes)
+        self.cell = tuple(cell)
+        self.device_kind = device_kind
+        super().__init__(
+            f"predicted HBM watermark {self.predicted_bytes} B exceeds the "
+            f"{device_kind or 'device'} admission ceiling "
+            f"{self.ceiling_bytes} B for shape cell {self.cell} "
+            "(telemetry/capacity.py; raise ServeContext.capacity_ceiling_"
+            "bytes or use a larger device kind)"
+        )
+
+
 class DeadlineExceededError(ServeError):
     """The request's deadline expired before execution started.
 

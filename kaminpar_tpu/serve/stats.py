@@ -53,7 +53,8 @@ class ServeStats:
     """Thread-safe accumulator for the engine's serving metrics."""
 
     _COUNTERS = (
-        "submitted", "admitted", "rejected_full", "timed_out", "cancelled",
+        "submitted", "admitted", "rejected_full", "rejected_capacity",
+        "timed_out", "cancelled",
         "completed", "failed", "batches", "warm_hits", "warm_misses",
         # Lane-stacked execution census (round 11, serve/lanestack.py):
         # batches run as one vmapped stack, total lanes they carried,
@@ -201,8 +202,8 @@ class ServeStats:
         endpoint."""
         snap = self.snapshot(queue_depth=queue_depth)
         outcome_counters = (
-            "submitted", "admitted", "rejected_full", "timed_out",
-            "cancelled", "completed", "failed",
+            "submitted", "admitted", "rejected_full", "rejected_capacity",
+            "timed_out", "cancelled", "completed", "failed",
         )
         lat_samples = []
         count_samples = []

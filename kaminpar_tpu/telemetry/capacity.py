@@ -1,0 +1,568 @@
+"""HBM capacity planner (ISSUE 12 tentpole b).
+
+The reference partitions 1B-edge graphs only because memory is budgeted per
+level by construction (PAPER.md layer map; TeraPart-style compression
+exists precisely to fit HBM) — yet this repo's HBM story was a hand-derived
+table (HBM_BUDGET.md).  This module makes the budget *executable*: a
+closed-form resident-buffer model (dense ``PaddedView`` vs
+``DeviceCompressedView`` vs per-shard ``DistDeviceCompressedView``)
+composed with the executable census's per-cell temp bytes — XLA's own
+``memory_analysis`` of the transient-dominating kernels, harvested via
+shape-only lowering (``jax.ShapeDtypeStruct``; no device data ever exists)
+— predicts the HBM watermark of a (family, scale, k, P, lanes,
+device_decode) cell against a per-device-kind ceiling.
+
+Three consumers:
+
+- ``python -m kaminpar_tpu.tools capacity`` prints the fit/no-fit ladder
+  and the max feasible scale per arm (and regenerates the HBM_BUDGET.md
+  tables with measured-vs-predicted columns via ``--validate``);
+- :class:`~kaminpar_tpu.serve.engine.PartitionEngine` runs an **admission
+  preflight** (:func:`preflight`): a request whose predicted watermark
+  exceeds the engine's ceiling is rejected with a typed
+  :class:`~kaminpar_tpu.serve.errors.CapacityError` *before* anything is
+  compiled — the first piece of the ROADMAP serve-fleet SLO-aware
+  admission;
+- tests validate predictions against
+  ``heap_profiler.watermark_report()`` on CPU (the ``cpu_rss_proxy``
+  backend's ``live_array_bytes``) for the dense and ``device_decode`` arms
+  at scale 12 (tests/test_capacity.py, tolerance stated in
+  :data:`VALIDATION_TOLERANCE`).
+
+Model semantics (also TPU_NOTES.md round 16): *resident* bytes are exact
+array-size arithmetic over the padded shape ladder; *workspace* covers the
+partition/label state the pipeline keeps between dispatches; *temp* is the
+XLA-reported transient of the worst single executable (contraction — the
+sort-reduce working set HBM_BUDGET identifies as the binding transient),
+scaled from a harvested cell when the exact cell was never compiled.  The
+hierarchy factor models coarse levels summing geometrically
+(HBM_BUDGET.md: bounded 3.5x/level shrink -> <= 1.4x the finest level).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: Stated tolerance of the predicted-vs-measured resident validation on
+#: CPU (tests/test_capacity.py): the closed-form model must land within
+#: this relative error of the constructed views' live-array bytes.
+VALIDATION_TOLERANCE = 0.35
+
+#: HBM per chip by device-kind substring (public TPU specs; the same
+#: matching convention as bench._hbm_peak).  CPU has no entry — ceilings
+#: there come from measured allocator limits or explicit overrides only.
+DEVICE_HBM_GIB = (
+    ("v6e", 32.0),
+    ("v5p", 95.0),
+    ("v5e", 16.0),
+    ("v5 lite", 16.0),
+    ("v4", 32.0),
+    ("v3", 16.0),
+    ("v2", 8.0),
+)
+
+#: Fraction of HBM the planner budgets for the partitioner (the rest covers
+#: the XLA runtime, fragmentation, and collective scratch — HBM_BUDGET.md
+#: works at ~60%; the planner keeps the same headroom).
+DEFAULT_HEADROOM = 0.6
+
+#: Directed-edge-per-node models per synthetic family at edge_factor ef
+#: (generators.py semantics; rmat's dedup+symmetrize lands at ~0.87 of the
+#: nominal 2*ef, measured across scales 12-16).
+_FAMILY_M_PER_NODE = {
+    "rmat": lambda ef: 2.0 * ef * 0.87,
+    "rgg": lambda ef: 25.0,
+    "grid": lambda ef: 4.0,
+}
+
+#: Compressed-stream bytes per directed edge by family (HBM_BUDGET.md
+#: round-14 measured table: rmat 9.8 weighted, rgg 4.6, grid 13.7 —
+#: per-node decode metadata dominates low-degree families).
+_FAMILY_COMPRESSED_B_PER_EDGE = {"rmat": 9.8, "rgg": 4.6, "grid": 13.7}
+
+#: Fallback transient model when no census cell is harvested: the
+#: sort-reduce contraction's working set roughly doubles the edge arrays
+#: (HBM_BUDGET.md) — 3 int32 edge arrays in + the sort scratch.
+_TEMP_BYTES_PER_EDGE_FALLBACK = 24.0
+
+_ITEM = 4  # int32 build; the 64-bit switch doubles edge arrays (noted)
+
+
+def device_ceiling_bytes(device_kind: str,
+                         headroom: float = DEFAULT_HEADROOM) -> Optional[int]:
+    """Usable HBM bytes per chip for a device kind, after headroom; None
+    for unknown kinds (CPU included — no static ceiling exists there)."""
+    dk = (device_kind or "").lower()
+    for key, gib in DEVICE_HBM_GIB:
+        if key in dk:
+            return int(gib * (1 << 30) * headroom)
+    return None
+
+
+def _next_bucket(x: int) -> int:
+    from ..utils.intmath import next_shape_bucket
+
+    return next_shape_bucket(max(int(x), 1), 256)
+
+
+def family_shape(family: str, scale: int, edge_factor: int = 16):
+    """(n, m_directed) estimate for a synthetic family at ``scale``
+    (n = 2**scale; m from the per-family degree model)."""
+    fam = family.lower()
+    if fam not in _FAMILY_M_PER_NODE:
+        raise ValueError(
+            f"unknown family {family!r}; known: {sorted(_FAMILY_M_PER_NODE)}"
+        )
+    n = 1 << int(scale)
+    m = int(n * _FAMILY_M_PER_NODE[fam](edge_factor))
+    return n, m
+
+
+# -- resident-buffer model ---------------------------------------------------
+
+
+#: Slot inflation of the bucketed layout over m_pad when no degree data is
+#: at hand: each row occupies its pow2 width class, so skewed families pay
+#: 2-3x (rmat measured 2.0x at scale 16, 3.1x at scale 12 — the small-graph
+#: end is worse because width classes are emptier).
+DEFAULT_SLOT_FACTOR = 2.2
+
+
+def _bucketed_layout_bytes(deg) -> int:
+    """Exact byte count of the dense bucketed layout for a degree vector —
+    the SAME width plan the builder uses (graph/bucketed.node_width_plan:
+    per-bucket (nodes + cols + wgts) at R_pad x w, heavy rows flat).  Pure
+    host integer math over host degrees; never builds an array."""
+    import numpy as np
+
+    from ..graph.bucketed import node_width_plan
+    from ..utils.intmath import next_pow2
+
+    deg = np.asarray(deg, dtype=np.int64)
+    bwidth, heavy_mask = node_width_plan(deg)
+    total = 0
+    for w in np.unique(bwidth[~heavy_mask]):
+        R = int(((~heavy_mask) & (bwidth == w)).sum())
+        R_pad = next_pow2(R, 8)
+        total += R_pad * (2 * int(w) + 1)  # cols + wgts + nodes
+    Hr = int(heavy_mask.sum())
+    if Hr:
+        Hs = int(deg[heavy_mask].sum())
+        total += next_pow2(Hr + 1, 8) + 3 * next_pow2(Hs, 8)
+    return total * _ITEM
+
+
+def model_dense_resident_bytes(n_pad: int, m_pad: int, deg=None) -> int:
+    """Padded dense adjacency tier: the PaddedView CSR (row_ptr + node_w +
+    col/edge_w/edge_u) plus the bucketed layout's neighbor matrices and
+    gather table.  With ``deg`` (a host degree vector) the bucketed term is
+    exact — the same width plan the builder runs; without it, the
+    :data:`DEFAULT_SLOT_FACTOR` estimate covers the pow2 width classes."""
+    csr = (2 * n_pad + 1 + 3 * m_pad) * _ITEM
+    if deg is not None:
+        bucketed = _bucketed_layout_bytes(deg) + n_pad * _ITEM
+    else:
+        slots = int(m_pad * DEFAULT_SLOT_FACTOR)
+        bucketed = (2 * slots + n_pad) * _ITEM
+    return csr + bucketed
+
+
+def host_degrees(graph):
+    """Host degree vector of a CSR graph WITHOUT a device transfer, or None
+    when only a device row_ptr exists (generator/IO graphs carry a host
+    copy; the preflight path falls back to the slot-factor model rather
+    than pulling)."""
+    import numpy as np
+
+    rp = getattr(graph, "_host_row_ptr", None)
+    return None if rp is None else np.diff(rp)
+
+
+def model_compressed_resident_bytes(
+    n_pad: int, m_pad: int, *, words: Optional[int] = None,
+    weighted: bool = True, family: str = "rmat",
+) -> int:
+    """Compressed adjacency tier: packed gap words + (for weighted graphs)
+    the uncompressed weight side stream + per-node decode metadata
+    (word_start/width/degree/node_w + bucket rows ~ 5 ints/node + gather).
+    ``words`` (exact packed word count, from a real ``CompressedGraph``)
+    beats the per-family bytes/edge estimate when available."""
+    node_meta = (4 + 5 + 1) * n_pad * _ITEM  # padded arrays+bucket rows+gather
+    if words is not None:
+        stream = _next_bucket(words + 1) * _ITEM
+        side = m_pad * _ITEM if weighted else _ITEM
+        return stream + side + node_meta
+    # Family estimate: the measured bytes/edge (HBM_BUDGET round 14) covers
+    # stream + side stream + metadata; floor at the metadata term so sparse
+    # families can't model below their per-node overhead.
+    per_edge = _FAMILY_COMPRESSED_B_PER_EDGE.get(family.lower(), 9.8)
+    return max(int(m_pad * per_edge), node_meta)
+
+
+def model_workspace_bytes(n_pad: int, k: int, lanes: int = 1) -> int:
+    """Between-dispatch pipeline state: labels/partition/best + LP label
+    weights + moved masks ~ 6 int32 arrays of n_pad plus k-sized block
+    tables, all multiplied by the vmapped lane count."""
+    return lanes * (6 * n_pad + 4 * max(int(k), 2)) * _ITEM
+
+
+# Cells whose harvest already ran (successfully or not) this process —
+# a failed lower/compile (e.g. >int32-indexing scales) must not be
+# retried on every predict()/ladder row.
+_harvest_attempted: set = set()
+
+
+def harvest_contraction_cell(n_pad: int, m_pad: int) -> Optional[dict]:
+    """Harvest the (n_pad, m_pad) contraction executable into the census
+    (shared key ``capacity_contraction|n,m`` — the engine warmup and the
+    planner reuse each other's rows): lower + compile the sort-reduce
+    transient dominator (HBM_BUDGET.md) from ``jax.ShapeDtypeStruct``
+    shapes — no device data — and read XLA's cost/memory analyses.  Cached
+    cells (including failed attempts) never recompile; returns the census
+    row or None."""
+    from ..utils import compile_stats
+
+    key = (int(n_pad), int(m_pad))
+    snap = compile_stats.executable_census_snapshot()
+    cached = snap.get(f"capacity_contraction|{key[0]},{key[1]}")
+    if cached is not None:
+        return cached
+    if not compile_stats.executable_census_armed() or key in _harvest_attempted:
+        return None
+    _harvest_attempted.add(key)
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.contraction import _contract_device
+
+    nn = jax.ShapeDtypeStruct((key[0],), jnp.int32)
+    mm = jax.ShapeDtypeStruct((key[1],), jnp.int32)
+    return compile_stats.harvest_fn(
+        "capacity_contraction", _contract_device, nn, mm, mm, mm, nn,
+        cell=key,
+    )
+
+
+def harvest_temp_bytes(n_pad: int, m_pad: int,
+                       harvest: bool = True) -> Optional[int]:
+    """The XLA-reported temp bytes of the (n_pad, m_pad) contraction cell:
+    the cached census row when one exists, else (``harvest=True`` only) one
+    lower+compile attempt via :func:`harvest_contraction_cell`.
+    ``harvest=False`` is the serve-preflight contract — the submit path
+    must NEVER block on a compile, so it reads the cache and falls back to
+    the closed-form model."""
+    from ..utils import compile_stats
+
+    cached = compile_stats.census_peak_temp_bytes(
+        "capacity_contraction", (n_pad, m_pad)
+    )
+    if cached is not None:
+        return cached
+    if not harvest:
+        return None
+    row = harvest_contraction_cell(n_pad, m_pad)
+    return None if row is None else row.get("temp_bytes")
+
+
+def model_temp_bytes(n_pad: int, m_pad: int) -> int:
+    """Closed-form transient estimate for a cell with no harvested number:
+    the nearest harvested contraction cell scaled by edge count, else the
+    sort-reduce bytes/edge fallback.  Never lowers or compiles."""
+    from ..utils import compile_stats
+
+    snap = compile_stats.executable_census_snapshot()
+    best = None
+    for key, row in snap.items():
+        if not key.startswith("capacity_contraction|"):
+            continue
+        if row.get("temp_bytes") is None:
+            continue
+        try:
+            _, m_h = (int(x) for x in key.split("|", 1)[1].split(","))
+        except ValueError:
+            continue
+        score = abs(math.log(max(m_h, 1) / max(m_pad, 1)))
+        if best is None or score < best[0]:
+            best = (score, row["temp_bytes"], m_h)
+    if best is not None:
+        return int(best[1] * (m_pad / max(best[2], 1)))
+    return int(m_pad * _TEMP_BYTES_PER_EDGE_FALLBACK)
+
+
+#: Hierarchy factor: coarse levels' arrays sum geometrically on top of the
+#: finest level (HBM_BUDGET.md: <= 1.4x with padding amortized ~1.3x).
+HIERARCHY_FACTOR = 1.4
+
+#: Sharding pad tax: m_loc pads to the max shard's pow2 bucket
+#: (HBM_BUDGET.md round 15 — skewed rmat measured ~1.3x over m/P).
+SHARD_PAD_FACTOR = 1.3
+
+
+@dataclass
+class CapacityPrediction:
+    """One cell's predicted watermark against a ceiling."""
+
+    family: str
+    scale: int
+    k: int
+    P: int = 1
+    lanes: int = 1
+    device_decode: bool = False
+    n: int = 0
+    m: int = 0
+    n_pad: int = 0
+    m_pad: int = 0
+    resident_bytes: int = 0
+    workspace_bytes: int = 0
+    temp_bytes: int = 0
+    hierarchy_bytes: int = 0
+    predicted_peak_bytes: int = 0
+    ceiling_bytes: Optional[int] = None
+    device_kind: str = ""
+    temp_source: str = "model"
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def fits(self) -> Optional[bool]:
+        if self.ceiling_bytes is None:
+            return None
+        return self.predicted_peak_bytes <= self.ceiling_bytes
+
+    def to_dict(self) -> dict:
+        out = {
+            k: getattr(self, k)
+            for k in (
+                "family", "scale", "k", "P", "lanes", "device_decode",
+                "n", "m", "n_pad", "m_pad", "resident_bytes",
+                "workspace_bytes", "temp_bytes", "hierarchy_bytes",
+                "predicted_peak_bytes", "ceiling_bytes", "device_kind",
+                "temp_source", "notes",
+            )
+        }
+        out["fits"] = self.fits
+        return out
+
+
+def predict(
+    family: str = "rmat",
+    scale: int = 16,
+    k: int = 8,
+    *,
+    P: int = 1,
+    lanes: int = 1,
+    device_decode: bool = False,
+    edge_factor: int = 16,
+    device_kind: str = "",
+    ceiling_bytes: Optional[int] = None,
+    n: Optional[int] = None,
+    m: Optional[int] = None,
+    words: Optional[int] = None,
+    weighted: bool = True,
+    deg=None,
+    harvest: bool = True,
+) -> CapacityPrediction:
+    """Predicted per-device HBM watermark of one workload cell.
+
+    ``n``/``m`` override the family model (exact graph shapes); ``words``
+    feeds the compressed model an exact packed stream length.  ``P`` > 1
+    models the sharded dist tier (per-shard slices + the round-15 pad
+    tax); ``lanes`` > 1 the lane-stacked serve pipeline (workspace and
+    adjacency replicate per lane).
+    """
+    if n is None or m is None:
+        fn, fm = family_shape(family, scale, edge_factor)
+        n = fn if n is None else n
+        m = fm if m is None else m
+    P = max(int(P), 1)
+    lanes = max(int(lanes), 1)
+    # Per-shard slice on the mesh (+ pad tax); lanes stack whole graphs.
+    m_dev = int(m / P * (SHARD_PAD_FACTOR if P > 1 else 1.0)) * lanes
+    n_dev = int(n / P * (SHARD_PAD_FACTOR if P > 1 else 1.0)) * lanes
+    n_pad = _next_bucket(n_dev)
+    m_pad = _next_bucket(m_dev)
+    if device_decode:
+        resident = model_compressed_resident_bytes(
+            n_pad, m_pad, words=words, weighted=weighted, family=family
+        )
+    else:
+        resident = model_dense_resident_bytes(
+            n_pad, m_pad, deg=deg if P == 1 and lanes == 1 else None
+        )
+    workspace = model_workspace_bytes(n_pad, k, lanes=1)  # lanes in n_pad
+    temp_exact = harvest_temp_bytes(n_pad, m_pad, harvest=harvest)
+    temp = int(temp_exact) if temp_exact is not None else model_temp_bytes(
+        n_pad, m_pad
+    )
+    hierarchy = int((resident + workspace) * (HIERARCHY_FACTOR - 1.0))
+    peak = resident + workspace + hierarchy + temp
+    pred = CapacityPrediction(
+        family=family, scale=int(scale), k=int(k), P=P, lanes=lanes,
+        device_decode=bool(device_decode), n=int(n), m=int(m),
+        n_pad=n_pad, m_pad=m_pad, resident_bytes=int(resident),
+        workspace_bytes=int(workspace), temp_bytes=int(temp),
+        hierarchy_bytes=int(hierarchy), predicted_peak_bytes=int(peak),
+        device_kind=device_kind,
+        temp_source="xla_memory_analysis" if temp_exact is not None
+        else "model",
+    )
+    if ceiling_bytes is not None:
+        pred.ceiling_bytes = int(ceiling_bytes)
+    elif device_kind:
+        pred.ceiling_bytes = device_ceiling_bytes(device_kind)
+    if P > 1:
+        pred.notes.append(
+            f"per-shard slice with {SHARD_PAD_FACTOR}x pad tax (HBM_BUDGET r15)"
+        )
+    return pred
+
+
+def predict_for_graph(graph, k: int, *, device_decode: bool = False,
+                      lanes: int = 1, device_kind: str = "",
+                      ceiling_bytes: Optional[int] = None) -> CapacityPrediction:
+    """Prediction for a concrete in-memory graph (exact n/m, and the exact
+    bucketed layout when the graph carries a host row_ptr — the serve
+    preflight path; pure host integer math, zero device work, and
+    ``harvest=False``: the submit path reads only cached census rows, it
+    must never block on an XLA compile)."""
+    return predict(
+        "rmat", 0, k, lanes=lanes, device_decode=device_decode,
+        device_kind=device_kind, ceiling_bytes=ceiling_bytes,
+        n=int(graph.n), m=int(graph.m), deg=host_degrees(graph),
+        harvest=False,
+    )
+
+
+def ladder(
+    family: str = "rmat",
+    k: int = 64,
+    *,
+    device_kind: str = "v5e",
+    scales=range(16, 31),
+    P: int = 1,
+    lanes: int = 1,
+    edge_factor: int = 16,
+    ceiling_bytes: Optional[int] = None,
+) -> dict:
+    """The fit/no-fit ladder over ``scales`` for the dense and
+    device-decode arms, plus the max feasible scale of each (the ``tools
+    capacity`` payload)."""
+    rows = []
+    max_fit = {"dense": None, "device_decode": None}
+    for s in scales:
+        row = {}
+        for arm, dd in (("dense", False), ("device_decode", True)):
+            pred = predict(
+                family, s, k, P=P, lanes=lanes, device_decode=dd,
+                edge_factor=edge_factor, device_kind=device_kind,
+                ceiling_bytes=ceiling_bytes,
+            )
+            row[arm] = pred
+            if pred.fits:
+                max_fit[arm] = s
+        rows.append(row)
+    return {
+        "family": family, "k": k, "P": P, "lanes": lanes,
+        "device_kind": device_kind,
+        "ceiling_bytes": rows[0]["dense"].ceiling_bytes if rows else None,
+        "rows": rows,
+        "max_feasible_scale": max_fit,
+    }
+
+
+# -- CPU validation (tests/test_capacity.py + tools capacity --validate) -----
+
+
+def validate_cpu(scale: int = 12, edge_factor: int = 16, seed: int = 1) -> dict:
+    """Predicted-vs-measured resident bytes on the ambient (CPU) backend
+    for the dense and device-decode arms, measured as the live-array delta
+    of constructing each arm's device-resident views — the quantity
+    ``heap_profiler.watermark_report()`` reports as ``live_array_bytes``
+    under its ``cpu_rss_proxy`` backend.  Returns per-arm
+    {predicted, measured, rel_err}; tier-1 asserts rel_err <=
+    :data:`VALIDATION_TOLERANCE`."""
+    import jax
+
+    from ..graph.compressed import compress
+    from ..graph.device_compressed import DeviceCompressedView
+    from ..graph.generators import rmat_graph
+    from ..utils import heap_profiler
+
+    g = rmat_graph(int(scale), edge_factor=int(edge_factor), seed=int(seed))
+    out: dict = {
+        "scale": int(scale), "n": int(g.n), "m": int(g.m),
+        "tolerance": VALIDATION_TOLERANCE,
+        "watermark_backend": heap_profiler.watermark_backend(),
+    }
+
+    # Dense arm: the PaddedView CSR + the bucketed layout.
+    before = heap_profiler.live_array_bytes()
+    pv = g.padded()
+    bv = g.bucketed()
+    jax.block_until_ready(pv.col_idx)
+    measured_dense = heap_profiler.live_array_bytes() - before
+    pred_dense = model_dense_resident_bytes(
+        pv.n_pad, pv.m_pad, deg=host_degrees(g)
+    )
+    out["dense"] = {
+        "predicted_bytes": int(pred_dense),
+        "measured_bytes": int(measured_dense),
+        "rel_err": round(
+            abs(pred_dense - measured_dense) / max(measured_dense, 1), 4
+        ),
+    }
+    del bv
+
+    # Compressed (device_decode) arm: the DeviceCompressedView.
+    cg = compress(g)
+    before = heap_profiler.live_array_bytes()
+    cv = DeviceCompressedView(cg)
+    jax.block_until_ready(cv.stream.words)
+    measured_comp = heap_profiler.live_array_bytes() - before
+    pred_comp = model_compressed_resident_bytes(
+        cv.n_pad, cv.m_pad, words=int(len(cg.words)),
+        weighted=cg.edge_w is not None,
+    )
+    out["device_decode"] = {
+        "predicted_bytes": int(pred_comp),
+        "measured_bytes": int(measured_comp),
+        "rel_err": round(
+            abs(pred_comp - measured_comp) / max(measured_comp, 1), 4
+        ),
+    }
+    return out
+
+
+# -- serve admission preflight ----------------------------------------------
+
+
+def preflight(graph, k: int, *, ceiling_bytes: int, device_kind: str = "",
+              device_decode: bool = False, lanes: int = 1):
+    """Admission preflight for one serve request: predict the watermark and
+    raise :class:`~kaminpar_tpu.serve.errors.CapacityError` when it exceeds
+    the ceiling — BEFORE the engine queues (and later compiles) anything.
+    Pure host arithmetic: zero device work, zero blocking transfers."""
+    pred = predict_for_graph(
+        graph, k, device_decode=device_decode, lanes=lanes,
+        device_kind=device_kind, ceiling_bytes=ceiling_bytes,
+    )
+    if pred.fits is False:
+        from ..serve.errors import CapacityError
+
+        raise CapacityError(
+            predicted_bytes=pred.predicted_peak_bytes,
+            ceiling_bytes=int(ceiling_bytes),
+            cell=(pred.n_pad, pred.m_pad, int(k)),
+            device_kind=device_kind,
+        )
+    return pred
+
+
+def format_bytes(b: Optional[int]) -> str:
+    if b is None:
+        return "?"
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if b >= div:
+            return f"{b / div:.2f} {unit}"
+    return f"{b} B"

@@ -120,6 +120,15 @@ def build_entry(record: dict, *, kind: str, git_head: str = "",
 
     coll = record.get("collectives") or collective_stats.snapshot()
     compile_snap = record.get("compiled_shape_count") or compile_stats.snapshot()
+    # Executable census (round 16, ISSUE 12): the compact totals ride every
+    # entry — flops/bytes of what the harvested executables WOULD do, and
+    # the single-executable peak-bytes high-water mark the capacity
+    # planner's ceiling checks consume.  From the record when the measuring
+    # child embedded them, else this process's own registry.
+    census = record.get("executable_census")
+    if not census:
+        census = compile_stats.executable_census_snapshot()
+    census_totals = (census or {}).get("totals") or {}
 
     entry = {
         "schema": SCHEMA,
@@ -144,6 +153,12 @@ def build_entry(record: dict, *, kind: str, git_head: str = "",
         },
         "compiled_shapes": compile_snap.get("total", 0)
         if isinstance(compile_snap, dict) else compile_snap,
+        "executable_census": {
+            "executables": census_totals.get("executables", 0),
+            "flops": census_totals.get("flops", 0.0),
+            "bytes_accessed": census_totals.get("bytes_accessed", 0.0),
+            "peak_bytes_max": census_totals.get("peak_bytes_max", 0),
+        },
         "lint": record.get("lint"),
     }
     if extra:

@@ -84,6 +84,13 @@ AUX_PHASES = (
     # dispatch, zero pulls — asserted).
     "dist_compressed_build",
     "dist_compressed_decode",
+    # Executable-grade observability (round 16, ISSUE 12): the serve
+    # engine's HBM admission preflight (pure host arithmetic over the
+    # request's shape cell — a pull here is a contract violation and would
+    # be attributed loudly) and the flight recorder's heartbeat thread
+    # (reads phase boards + /proc, never the device).
+    "capacity_preflight",
+    "heartbeat",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

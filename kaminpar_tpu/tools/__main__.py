@@ -17,9 +17,10 @@ def main(argv=None) -> int:
     if name not in tools.REGISTRY:
         print(f"unknown tool '{name}'; available: {sorted(tools.REGISTRY)}")
         return 1
-    # lint is pure-AST and the ledger/regress pair is pure-JSON — none may
-    # touch jax (a dead tunnel must not wedge the CI gates).
-    if name not in ("lint", "ledger", "regress"):
+    # lint is pure-AST and the ledger/regress/doctor trio is pure-JSON —
+    # none may touch jax (a dead tunnel must not wedge the CI gates or the
+    # hang post-mortem itself).
+    if name not in ("lint", "ledger", "regress", "doctor"):
         from ..utils.platform import prefer_working_backend
 
         prefer_working_backend()

@@ -364,6 +364,190 @@ def regress(argv) -> int:
     return 1 if regressions else 0
 
 
+def capacity(argv) -> int:
+    """HBM capacity planner (ISSUE 12; telemetry/capacity.py): print the
+    fit/no-fit ladder of a workload family against a device kind's HBM
+    ceiling — resident-buffer model composed with XLA's own
+    memory-analysis temp bytes (the executable census) — plus the max
+    feasible scale per arm.  ``--validate`` additionally runs the CPU
+    predicted-vs-measured check (the tier-1 assertion, printed as the
+    measured-vs-predicted rows HBM_BUDGET.md embeds)."""
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="capacity")
+    p.add_argument("--device-kind", default="v5e",
+                   help="device kind substring for the HBM ceiling "
+                        "(v2/v3/v4/v5e/v5p/v6e; default v5e)")
+    p.add_argument("--family", default="rmat", help="rmat | rgg | grid")
+    p.add_argument("-k", type=int, default=64)
+    p.add_argument("--edge-factor", type=int, default=16)
+    p.add_argument("--scales", default="16:30",
+                   help="scale range lo:hi (inclusive; default 16:30)")
+    p.add_argument("-P", "--shards", type=int, default=1,
+                   help="mesh shards (per-shard slices + the r15 pad tax)")
+    p.add_argument("--lanes", type=int, default=1,
+                   help="lane-stacked batch width")
+    p.add_argument("--ceiling-bytes", type=int, default=None,
+                   help="explicit ceiling override (skips the device table)")
+    p.add_argument("--no-census", action="store_true",
+                   help="skip the XLA memory-analysis harvest (closed-form "
+                        "temp model only; no compiles)")
+    p.add_argument("--validate", action="store_true",
+                   help="run the scale-12 CPU predicted-vs-measured check")
+    p.add_argument("--validate-scale", type=int, default=12)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    from ..telemetry import capacity as cap
+    from ..utils import compile_stats
+
+    if not args.no_census:
+        compile_stats.arm_executable_census()
+    lo, _, hi = args.scales.partition(":")
+    scales = range(int(lo), int(hi or lo) + 1)
+    lad = cap.ladder(
+        args.family, args.k, device_kind=args.device_kind, scales=scales,
+        P=args.shards, lanes=args.lanes, edge_factor=args.edge_factor,
+        ceiling_bytes=args.ceiling_bytes,
+    )
+    validation = cap.validate_cpu(args.validate_scale,
+                                  args.edge_factor) if args.validate else None
+    if args.as_json:
+        out = {
+            **{k: lad[k] for k in ("family", "k", "P", "lanes",
+                                   "device_kind", "ceiling_bytes",
+                                   "max_feasible_scale")},
+            "rows": [
+                {arm: row[arm].to_dict() for arm in row}
+                for row in lad["rows"]
+            ],
+        }
+        if validation is not None:
+            out["validation"] = validation
+        print(_json.dumps(out))
+        return 0
+    ceiling = lad["ceiling_bytes"]
+    print(f"capacity ladder: {args.family} k={args.k} P={args.shards} "
+          f"lanes={args.lanes} on {args.device_kind} "
+          f"(ceiling {cap.format_bytes(ceiling)}"
+          f" = HBM x {cap.DEFAULT_HEADROOM:.0%} headroom)")
+    print(f"  {'scale':>5} {'m (est)':>12} {'dense peak':>12} {'fit':>4} "
+          f"{'decode peak':>12} {'fit':>4}  temp source")
+    for row in lad["rows"]:
+        d, c = row["dense"], row["device_decode"]
+
+        def _fit(pred):
+            return {True: "yes", False: "NO", None: "?"}[pred.fits]
+
+        print(f"  {d.scale:>5} {d.m:>12,} "
+              f"{cap.format_bytes(d.predicted_peak_bytes):>12} {_fit(d):>4} "
+              f"{cap.format_bytes(c.predicted_peak_bytes):>12} {_fit(c):>4}"
+              f"  {d.temp_source}")
+    mf = lad["max_feasible_scale"]
+    print(f"  max feasible scale: dense {mf['dense']}, "
+          f"device_decode {mf['device_decode']}")
+    if validation is not None:
+        print(f"  CPU validation (scale {validation['scale']}, backend "
+              f"{validation['watermark_backend']}, tolerance "
+              f"{validation['tolerance']:.0%}):")
+        for arm in ("dense", "device_decode"):
+            v = validation[arm]
+            print(f"    {arm}: predicted "
+                  f"{cap.format_bytes(v['predicted_bytes'])} vs measured "
+                  f"{cap.format_bytes(v['measured_bytes'])} "
+                  f"(rel err {v['rel_err']:.1%})")
+    return 0
+
+
+def doctor(argv) -> int:
+    """Hang forensics over a prober log (ISSUE 12): outcome and hang-phase
+    histograms, init-time stats, and the newest dossier's stack tail —
+    the summary that turns a wall of ``init_hang_killed_after_1200s``
+    lines into a diagnosis.  Pure JSON reading: never touches jax."""
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="doctor")
+    p.add_argument("log", nargs="?", default=None,
+                   help="probe log path (default: TPU_PROBE_LOG.jsonl in "
+                        "the repo root)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--stack-lines", type=int, default=12)
+    args = p.parse_args(argv)
+    import os as _os
+
+    path = args.log or _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__)))), "TPU_PROBE_LOG.jsonl")
+    attempts, events = [], []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue
+                (attempts if "attempt" in rec else events).append(rec)
+    except OSError as exc:
+        print(f"error: cannot read {path}: {exc}")
+        return 1
+    outcomes: dict = {}
+    phases: dict = {}
+    init_s = []
+    last_dossier = None
+    for a in attempts:
+        out = str(a.get("outcome", "?"))
+        outcomes[out] = outcomes.get(out, 0) + 1
+        dossier = a.get("dossier")
+        if dossier:
+            phases[dossier.get("phase", "?")] = (
+                phases.get(dossier.get("phase", "?"), 0) + 1
+            )
+            last_dossier = (a.get("attempt"), dossier)
+        elif "hang_killed" in out:
+            phases["(no dossier)"] = phases.get("(no dossier)", 0) + 1
+        probe = a.get("probe") or {}
+        if isinstance(probe, dict) and probe.get("init_s") is not None:
+            init_s.append(float(probe["init_s"]))
+    summary = {
+        "log": path,
+        "attempts": len(attempts),
+        "outcomes": dict(sorted(outcomes.items())),
+        "hang_phases": dict(sorted(phases.items())),
+        "events": [e.get("event") for e in events],
+        "init_s": {
+            "count": len(init_s),
+            "mean": round(sum(init_s) / len(init_s), 1) if init_s else None,
+            "max": max(init_s) if init_s else None,
+        },
+    }
+    if args.as_json:
+        if last_dossier:
+            summary["last_dossier_attempt"] = last_dossier[0]
+            summary["last_dossier"] = last_dossier[1]
+        print(_json.dumps(summary))
+        return 0
+    print(f"doctor: {path}")
+    print(f"  attempts: {summary['attempts']}")
+    for out, cnt in summary["outcomes"].items():
+        print(f"    {out}: {cnt}")
+    if phases:
+        print("  hang phases (from dossiers):")
+        for ph, cnt in summary["hang_phases"].items():
+            print(f"    {ph}: {cnt}")
+    if init_s:
+        print(f"  successful init_s: n={len(init_s)} "
+              f"mean={summary['init_s']['mean']} max={summary['init_s']['max']}")
+    if last_dossier:
+        att, dossier = last_dossier
+        hb = dossier.get("last_heartbeat", {})
+        print(f"  last dossier (attempt {att}): phase={dossier.get('phase')} "
+              f"class={dossier.get('phase_class')} "
+              f"heartbeats={dossier.get('heartbeats')} "
+              f"rss={hb.get('rss_bytes')}")
+        for ln in (dossier.get("stack_tail") or [])[-args.stack_lines:]:
+            print(f"    | {ln}")
+    return 0
+
+
 def lint(argv) -> int:
     """kptlint (ISSUE 7): AST-level enforcement of the device-discipline
     contracts — sync budget, runtime isolation, phase registry, RNG and
@@ -376,6 +560,8 @@ def lint(argv) -> int:
 
 
 REGISTRY = {
+    "capacity": capacity,
+    "doctor": doctor,
     "graph-properties": graph_properties,
     "ledger": ledger,
     "lint": lint,

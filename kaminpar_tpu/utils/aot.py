@@ -46,6 +46,24 @@ def _export_one(results, failures, name, fn, *args, platforms, **kwargs):
         results[name] = len(exp.mlir_module_serialized)
     except Exception as e:  # noqa: BLE001 — collect every failure, then raise
         failures[name] = f"{type(e).__name__}: {e}"
+        return
+    # Executable census at the AOT site (ISSUE 12): when armed, lower (and
+    # compile for the AMBIENT backend — TPU-targeted compiles need the
+    # chip) and harvest XLA's cost/memory analyses under the kernel's name.
+    # Guarded by armed-ness so the tier-1 lowering suite pays nothing.
+    from . import compile_stats
+
+    if compile_stats.executable_census_armed():
+        # Cell label: the largest flat operand shapes (the n_pad/m_pad
+        # carriers) — pytrees/scalars among args carry no useful label.
+        dims = sorted(
+            {int(a.shape[0]) for a in args
+             if hasattr(a, "shape") and getattr(a, "ndim", 0) == 1},
+            reverse=True,
+        )
+        compile_stats.harvest_fn(
+            f"aot_{name}", fn, *args, cell=tuple(dims[:2]), **kwargs
+        )
 
 
 def _shm_suite(results, failures, platforms, *, use_64bit: bool = False):

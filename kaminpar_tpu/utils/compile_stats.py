@@ -13,6 +13,22 @@ controls and what a cold environment pays for).
 ``bench.py`` embeds :func:`snapshot` in its headline JSON
 (``compiled_shape_count``), and tests/test_pallas_lp.py asserts the v-cycle
 bound.
+
+Round 16 (ISSUE 12 tentpole a) adds the **executable census**: what every
+compiled program *would do* on silicon, straight from XLA's own analyses —
+``lowered.cost_analysis()`` (flops, bytes accessed) and
+``compiled.memory_analysis()`` (argument/output/temp/peak bytes) — keyed by
+``(kind, shape cell)``.  Harvest sites: the AOT export suites
+(utils/aot.py, ``census=True``), the serve engine's warmup cells
+(``PartitionEngine._warmup``), and :mod:`telemetry.capacity`'s planner
+lowerings.  The census is **armed explicitly** (:func:`arm_executable_census`)
+and is strictly host-side — lowering abstract shapes and reading analysis
+dicts performs zero device transfers and zero collectives, so an armed run
+is bit-identical to an unarmed one (asserted in tests/test_capacity.py).
+While armed, the jit-cache compile-event listener additionally attributes
+each compile event to the current sync-stats phase
+(:func:`compile_by_phase_snapshot`), so a trace/bench record shows *which
+phase* paid each cold compile.
 """
 
 from __future__ import annotations
@@ -26,6 +42,13 @@ _lock = threading.Lock()
 _shapes: dict = defaultdict(set)
 _compile_secs = {"backend_compile_s": 0.0, "trace_s": 0.0, "compile_events": 0}
 _listener_installed = False
+_census_armed = False
+# (kind, cell) -> {flops, bytes_accessed, argument_bytes, output_bytes,
+#                  temp_bytes, peak_bytes, generated_code_bytes, count}
+_census: dict = {}
+# phase -> {"events": n, "backend_compile_s": s} (armed-census attribution
+# of the jax.monitoring compile events to the sync-stats phase stack).
+_compile_by_phase: dict = {}
 
 
 def _sig_of(arrays, statics) -> tuple:
@@ -76,6 +99,8 @@ def reset() -> None:
         _compile_secs.update(
             {"backend_compile_s": 0.0, "trace_s": 0.0, "compile_events": 0}
         )
+        _census.clear()
+        _compile_by_phase.clear()
 
 
 def enable_compile_time_tracking() -> None:
@@ -88,10 +113,27 @@ def enable_compile_time_tracking() -> None:
     import jax.monitoring as monitoring
 
     def _cb(event, duration, **kwargs):
+        phase = None
+        if _census_armed and event.endswith("backend_compile_duration"):
+            # Attribute the compile to the dispatching thread's sync-stats
+            # phase (the listener fires on the thread that triggered the
+            # compile) — pure host bookkeeping, read before taking the lock.
+            try:
+                from . import sync_stats
+
+                phase = sync_stats._phase()
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                phase = None
         with _lock:
             if event.endswith("backend_compile_duration"):
                 _compile_secs["backend_compile_s"] += duration
                 _compile_secs["compile_events"] += 1
+                if phase is not None:
+                    row = _compile_by_phase.setdefault(
+                        phase, {"events": 0, "backend_compile_s": 0.0}
+                    )
+                    row["events"] += 1
+                    row["backend_compile_s"] += duration
             elif event.endswith("jaxpr_trace_duration"):
                 _compile_secs["trace_s"] += duration
 
@@ -106,3 +148,187 @@ def compile_time_snapshot() -> dict:
             "trace_s": round(_compile_secs["trace_s"], 2),
             "compile_events": _compile_secs["compile_events"],
         }
+
+
+# -- executable census (round 16, ISSUE 12) ----------------------------------
+
+
+def arm_executable_census(on: bool = True) -> None:
+    """Arm (or disarm) the executable census.  Armed harvesting is pure
+    host-side compiler introspection: zero blocking transfers, zero
+    collectives, bit-identical results (tests/test_capacity.py asserts
+    both).  Off by default so tier-1 engine warmups stay cheap."""
+    global _census_armed
+    _census_armed = bool(on)
+    if on:
+        enable_compile_time_tracking()
+
+
+def executable_census_armed() -> bool:
+    return _census_armed
+
+
+def _cell_key(kind: str, cell) -> str:
+    return f"{kind}|{','.join(str(c) for c in cell)}" if cell else kind
+
+
+def harvest(kind: str, lowered=None, compiled=None, cell=()) -> dict | None:
+    """Record one executable's cost/memory analysis under ``(kind, cell)``.
+
+    ``lowered`` is a ``jax.stages.Lowered`` (flops / bytes accessed via
+    ``cost_analysis``); ``compiled`` a ``jax.stages.Compiled``
+    (argument/output/temp bytes via ``memory_analysis``).  Either may be
+    None.  Never raises — a census failure must not void the compile it
+    rode on.  Returns the stored row (or None when nothing was harvested).
+    """
+    row = {
+        "flops": None, "bytes_accessed": None, "argument_bytes": None,
+        "output_bytes": None, "temp_bytes": None, "peak_bytes": None,
+        "generated_code_bytes": None, "count": 1,
+    }
+    got = False
+    try:
+        if lowered is not None:
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):  # per-device list on some jax
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                row["flops"] = float(ca.get("flops", 0.0))
+                row["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+                got = True
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        if compiled is not None:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                arg = int(getattr(ma, "argument_size_in_bytes", 0))
+                out = int(getattr(ma, "output_size_in_bytes", 0))
+                tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+                alias = int(getattr(ma, "alias_size_in_bytes", 0))
+                code = int(getattr(ma, "generated_code_size_in_bytes", 0))
+                row.update({
+                    "argument_bytes": arg, "output_bytes": out,
+                    "temp_bytes": tmp, "generated_code_bytes": code,
+                    # The executable's device high-water mark: arguments +
+                    # outputs + temporaries live simultaneously (aliased
+                    # donation bytes counted once — they overlap arguments).
+                    "peak_bytes": arg + out + tmp - alias + code,
+                })
+                got = True
+    except Exception:  # noqa: BLE001
+        pass
+    if not got:
+        return None
+    key = _cell_key(kind, cell)
+    with _lock:
+        prev = _census.get(key)
+        if prev is not None:
+            row["count"] = prev["count"] + 1
+        _census[key] = row
+    rec = _ttrace.active()
+    if rec is not None:
+        rec.counter("executable_census", {
+            k: v for k, v in row.items()
+            if k in ("flops", "bytes_accessed", "temp_bytes", "peak_bytes")
+            and v is not None
+        })
+    return row
+
+
+def harvest_fn(kind: str, fn, *args, cell=(), compile_it: bool = True,
+               **kwargs):
+    """Lower (and optionally compile) ``fn`` for the ambient backend and
+    harvest its analyses.  ``fn`` may be a jitted callable (lowered
+    directly) or a plain traceable (wrapped in a throwaway jit closed over
+    ``kwargs``).  ``args`` may be concrete arrays or
+    ``jax.ShapeDtypeStruct`` — shape-only lowering never touches device
+    data.  No-op (returns None) when the census is not armed."""
+    if not _census_armed:
+        return None
+    import jax
+
+    try:
+        target = fn if hasattr(fn, "lower") else None
+        if target is not None:
+            lowered = target.lower(*args, **kwargs)
+        else:
+            lowered = jax.jit(lambda *a: fn(*a, **kwargs)).lower(*args)
+        compiled = lowered.compile() if compile_it else None
+    except Exception:  # noqa: BLE001 — the census never voids the caller
+        return None
+    return harvest(kind, lowered, compiled, cell=cell)
+
+
+def executable_census_snapshot() -> dict:
+    """{ "kind|cell": {flops, bytes_accessed, ..., peak_bytes}, ... } plus
+    a ``totals`` row (sums over harvested executables; peak is a max — one
+    executable runs at a time)."""
+    with _lock:
+        out = {k: dict(v) for k, v in sorted(_census.items())}
+    totals = {
+        "executables": len(out),
+        "flops": sum(v["flops"] or 0.0 for v in out.values()),
+        "bytes_accessed": sum(v["bytes_accessed"] or 0.0 for v in out.values()),
+        "peak_bytes_max": max(
+            (v["peak_bytes"] or 0 for v in out.values()), default=0
+        ),
+    }
+    out["totals"] = totals
+    return out
+
+
+def census_peak_temp_bytes(kind: str, cell=()) -> int | None:
+    """The harvested temp bytes of ``(kind, cell)`` — the number the
+    capacity planner composes with the resident-buffer model; None when the
+    cell was never harvested."""
+    with _lock:
+        row = _census.get(_cell_key(kind, cell))
+    return None if row is None else row.get("temp_bytes")
+
+
+def compile_by_phase_snapshot() -> dict:
+    """{phase: {events, backend_compile_s}} — which phases paid the cold
+    compiles (populated while the census is armed)."""
+    with _lock:
+        return {
+            ph: {
+                "events": row["events"],
+                "backend_compile_s": round(row["backend_compile_s"], 3),
+            }
+            for ph, row in sorted(_compile_by_phase.items())
+        }
+
+
+def census_prometheus_families() -> list:
+    """The executable census as Prometheus families (rendered into
+    ``PartitionEngine.metrics_text()`` alongside the serve families)."""
+    snap = executable_census_snapshot()
+    totals = snap.pop("totals")
+    flops, peaks, temps = [], [], []
+    for key, row in snap.items():
+        kind, _, cell = key.partition("|")
+        labels = {"kind": kind, "cell": cell}
+        if row.get("flops") is not None:
+            flops.append((labels, row["flops"]))
+        if row.get("peak_bytes") is not None:
+            peaks.append((labels, row["peak_bytes"]))
+        if row.get("temp_bytes") is not None:
+            temps.append((labels, row["temp_bytes"]))
+    return [
+        ("kaminpar_executable_census_total", "gauge",
+         "Executables harvested by the compiled-executable census",
+         [({}, totals["executables"])]),
+        ("kaminpar_executable_flops", "gauge",
+         "XLA cost-analysis flops per compiled executable (kind, shape cell)",
+         flops or [({}, None)]),
+        ("kaminpar_executable_peak_bytes", "gauge",
+         "XLA memory-analysis peak bytes (arguments + outputs + temps) per "
+         "compiled executable",
+         peaks or [({}, None)]),
+        ("kaminpar_executable_temp_bytes", "gauge",
+         "XLA memory-analysis temp bytes per compiled executable — the "
+         "transient the HBM capacity planner composes with the resident "
+         "model (telemetry/capacity.py)",
+         temps or [({}, None)]),
+    ]

@@ -12,6 +12,7 @@ builds) it degrades to a no-op with a single warning.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -161,16 +162,76 @@ def memory_summary() -> Dict[str, int]:
     }
 
 
+def live_array_bytes() -> int:
+    """Total bytes of live jax arrays in this process (metadata sum over
+    ``jax.live_arrays()`` — no transfer).  On the CPU backend, where the
+    allocator exposes no stats, this is the honest device-buffer proxy the
+    capacity planner validates against (telemetry/capacity.py)."""
+    import jax
+
+    try:
+        return int(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:  # noqa: BLE001 — accounting must never fail a run
+        return 0
+
+
+def _rss_bytes() -> Dict[str, int]:
+    """Current and peak resident-set bytes of this process (Linux)."""
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/self/statm") as fh:
+            out["rss_bytes"] = int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        import resource
+
+        out["peak_rss_bytes"] = (
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def watermark_backend() -> str:
+    """Which measurement the watermark numbers come from (ISSUE 12
+    satellite): ``tpu_hbm`` (accelerator allocator stats),
+    ``cpu_allocator`` (a CPU build that exposes allocator stats), or
+    ``cpu_rss_proxy`` (no allocator stats — RSS + live-array fallback).
+    Consumers comparing watermarks against HBM ceilings (the ledger,
+    ``tools regress`` windows, HBM_BUDGET.md tables) MUST check this label:
+    a CPU-measured watermark is a host-memory proxy, never an HBM truth."""
+    stats = _device_stats()
+    if stats is None:
+        return "cpu_rss_proxy"
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        platform = "cpu"
+    return "tpu_hbm" if platform != "cpu" else "cpu_allocator"
+
+
 def watermark_report() -> Dict[str, object]:
     """HBM watermark record for bench.py / the prober (ISSUE 5 satellite):
     live bytes, the peak high-water mark, the allocator limit, and the peak's
     fraction of it — the number to cross-check against the per-chip budgets
-    derived in HBM_BUDGET.md.  Empty values on backends without allocator
-    stats (most CPU builds) — the absence is the honest reading."""
+    derived in HBM_BUDGET.md.  Every record is labeled with its measurement
+    ``backend`` (ISSUE 12 satellite): allocator-less backends (most CPU
+    builds) fall back to the RSS proxy + live-array bytes instead of
+    silently reporting nothing — so a CPU-measured watermark can never be
+    mistaken for an HBM number in the ledger or a regress window."""
     out: Dict[str, object] = dict(memory_summary())
+    backend = watermark_backend()
+    out["backend"] = backend
     peak = out.get("peak_bytes_in_use")
     limit = out.get("bytes_limit")
     if peak is not None and limit:
         out["peak_frac_of_limit"] = round(int(peak) / int(limit), 4)
+    if backend == "cpu_rss_proxy":
+        out.update(_rss_bytes())
+        out["live_array_bytes"] = live_array_bytes()
     out["budget_doc"] = "HBM_BUDGET.md"
     return out

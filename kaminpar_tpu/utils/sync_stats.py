@@ -63,6 +63,12 @@ _counts: Dict[str, list] = {}
 _tls = threading.local()
 _budget_checks = False
 _DEFAULT_PHASE = "untracked"
+# Cross-thread phase board (round 16, ISSUE 12): thread ident -> (thread
+# name, live reference to that thread's phase stack).  The flight
+# recorder's heartbeat thread reads it to attribute a hang to the phase
+# the process died in; reads race benignly (a torn read sees a stack one
+# push/pop off, never a crash).
+_phase_board: Dict[int, tuple] = {}
 
 
 def _phase() -> str:
@@ -74,6 +80,10 @@ def push_phase(name: str) -> None:
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
+        with _lock:
+            _phase_board[threading.get_ident()] = (
+                threading.current_thread().name or "thread", stack
+            )
     stack.append(name)
 
 
@@ -81,6 +91,23 @@ def pop_phase() -> None:
     stack = getattr(_tls, "stack", None)
     if stack:
         stack.pop()
+
+
+def current_phases() -> Dict[str, str]:
+    """{thread name: innermost open phase} across every thread that ever
+    pushed one — the flight recorder's hang-attribution source (threads
+    with an empty stack report ""; dead threads linger harmlessly until
+    process exit)."""
+    with _lock:
+        board = list(_phase_board.values())
+    out = {}
+    for name, stack in board:
+        # [-1:] is a single (GIL-atomic) read of the live list — the owning
+        # thread may pop between a truthiness check and an index, so the
+        # check-then-index idiom would raise here.
+        top = stack[-1:]
+        out[name] = top[0] if top else ""
+    return out
 
 
 @contextmanager

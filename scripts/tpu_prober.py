@@ -24,6 +24,7 @@ Usage:  python scripts/tpu_prober.py [--daemon]
 
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import signal
@@ -32,8 +33,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG_PATH = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
-RESULT_PATH = os.path.join(REPO, "TPU_RESULT.json")
+# Scratch/log dir, overridable so the forced-hang tier-1 test can run a
+# real attempt without touching the repo's probe log.
+WORK_DIR = os.environ.get("KPTPU_PROBER_DIR", REPO)
+LOG_PATH = os.path.join(WORK_DIR, "TPU_PROBE_LOG.jsonl")
+RESULT_PATH = os.path.join(WORK_DIR, "TPU_RESULT.json")
 
 # A bare jax.devices() has been observed to hang >560 s before being killed
 # (VERDICT r4 missing #1).  Give init well more than that, and the whole
@@ -41,7 +45,26 @@ RESULT_PATH = os.path.join(REPO, "TPU_RESULT.json")
 INIT_TIMEOUT_S = float(os.environ.get("KPTPU_PROBER_INIT_TIMEOUT", 1200))
 ATTEMPT_TIMEOUT_S = float(os.environ.get("KPTPU_PROBER_ATTEMPT_TIMEOUT", 3600))
 RETRY_SLEEP_S = float(os.environ.get("KPTPU_PROBER_RETRY_SLEEP", 600))
+# Bounded-exponential retry escalation (ISSUE 12 satellite): after >= 3
+# consecutive killed-hang attempts the sleep doubles per further hang up to
+# this cap — 16 identical 1200 s init hangs at a fixed 600 s sleep burned a
+# whole 11 h window (TPU_PROBE_LOG rounds 15-16) probing a tunnel that was
+# evidently down all day.
+RETRY_SLEEP_MAX_S = float(os.environ.get("KPTPU_PROBER_RETRY_MAX", 3600))
 DEADLINE_H = float(os.environ.get("KPTPU_PROBER_HOURS", 11))
+
+
+def _flight_recorder_mod():
+    """Load telemetry/flight_recorder.py STANDALONE (by file path, pure
+    stdlib) so the child can heartbeat before ``import jax`` — backend-init
+    hangs are exactly the case the recorder exists for."""
+    path = os.path.join(
+        REPO, "kaminpar_tpu", "telemetry", "flight_recorder.py"
+    )
+    spec = importlib.util.spec_from_file_location("kpt_flight_recorder", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _log(rec: dict) -> None:
@@ -55,8 +78,25 @@ def child_attempt() -> None:
     """One probe+measure attempt on the ambient backend (runs in a fresh
     process).  Prints flushed JSON lines; exit codes: 0 = measured on
     accelerator, 3 = ambient backend resolved to CPU (tunnel absent), 4 =
-    init raised."""
+    init raised.
+
+    Flight recorder (ISSUE 12): heartbeats start BEFORE jax is imported
+    (standalone module load) and a faulthandler stack dump is armed just
+    under the parent's kill timeout, so a killed attempt leaves a
+    diagnosable dossier instead of ``probe: null``."""
     t0 = time.time()
+    recorder = None
+    try:
+        recorder = _flight_recorder_mod().arm_from_env()
+    except Exception:  # noqa: BLE001 — forensics must never fail the probe
+        pass
+    if recorder is not None:
+        recorder.note("backend_init")
+    if os.environ.get("KPTPU_PROBER_TEST_HANG") == "init":
+        # Forced-hang hook (tests/test_capacity.py): simulate the observed
+        # jax.devices() wedge so the kill/dossier path is exercised for
+        # real — the parent must SIGKILL this sleep.
+        time.sleep(10**7)
     try:
         import jax
 
@@ -65,6 +105,19 @@ def child_attempt() -> None:
         print(json.dumps({"probe": "init_error",
                           "error": f"{type(exc).__name__}: {exc}"[:300]}), flush=True)
         sys.exit(4)
+    if recorder is not None:
+        recorder.note("bench")
+        # Init is over: re-arm the single faulthandler slot against the
+        # ATTEMPT deadline (passed by the parent), so an execute-phase
+        # hang killed at ATTEMPT_TIMEOUT_S carries its own dying stack,
+        # not a stale init-era dump from 0.8 x INIT_TIMEOUT_S.
+        try:
+            attempt_dump_at = float(
+                os.environ.get("KPTPU_FLIGHT_STACK_AFTER_OK_S", 0)
+            )
+            recorder.rearm_stack_dump(attempt_dump_at - (time.time() - t0))
+        except Exception:  # noqa: BLE001
+            pass
     plat = devs[0].platform
     print(json.dumps({
         "probe": "devices_ok",
@@ -155,15 +208,36 @@ def run_attempt(attempt: int) -> dict | None:
     text-mode pipe raise TypeError when no data is buffered (observed on
     this box's Python 3.12 — it killed the round-5 daemon on its first poll),
     and a killed child can never wedge a file the way it wedges a pipe
-    reader."""
+    reader.
+
+    Killed attempts carry a **dossier** (ISSUE 12): the parent arms the
+    child's flight recorder (heartbeat sidecar + faulthandler stack dump
+    timed just under the kill) and, after the kill, assembles last
+    heartbeat + phase + stack tail + env fingerprint into the log record —
+    and classifies the outcome string by the dying phase (init vs compile
+    vs execute hang)."""
     t_start = time.time()
-    out_path = os.path.join(REPO, f".tpu_probe_attempt_{attempt}.out")
+    fr = _flight_recorder_mod()
+    out_path = os.path.join(WORK_DIR, f".tpu_probe_attempt_{attempt}.out")
+    # Sidecar contract single-sourced in flight_recorder.child_sidecar_env;
+    # attempt_after_s arms the post-devices_ok re-arm so execute-phase
+    # hangs carry their own dying stack (child-clock seconds).
+    fr_env, hb_path, stack_path = fr.child_sidecar_env(
+        out_path, min(INIT_TIMEOUT_S, ATTEMPT_TIMEOUT_S),
+        attempt_after_s=ATTEMPT_TIMEOUT_S,
+    )
+    child_env = dict(os.environ)
+    hb_override = child_env.get("KPTPU_HEARTBEAT_S")
+    child_env.update(fr_env)
+    if hb_override is not None:
+        child_env["KPTPU_HEARTBEAT_S"] = hb_override
     outf = open(out_path, "w+")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child"],
         stdout=outf,
         stderr=subprocess.DEVNULL,
         start_new_session=True,
+        env=child_env,
     )
 
     def read_so_far() -> str:
@@ -173,7 +247,9 @@ def run_attempt(attempt: int) -> dict | None:
 
     buf = ""
     devices_ok = False
+    killed = False
     outcome = ""
+    poll_s = max(0.2, min(5.0, INIT_TIMEOUT_S / 5.0))
     while True:
         elapsed = time.time() - t_start
         if proc.poll() is not None:
@@ -183,12 +259,14 @@ def run_attempt(attempt: int) -> dict | None:
         if '"devices_ok"' in buf:
             devices_ok = True
         if not devices_ok and elapsed > INIT_TIMEOUT_S:
+            killed = True
             outcome = f"init_hang_killed_after_{elapsed:.0f}s"
             break
         if elapsed > ATTEMPT_TIMEOUT_S:
+            killed = True
             outcome = f"attempt_killed_after_{elapsed:.0f}s"
             break
-        time.sleep(5.0)
+        time.sleep(poll_s)
     if proc.poll() is None:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
@@ -197,6 +275,22 @@ def run_attempt(attempt: int) -> dict | None:
         time.sleep(1.0)
         buf = read_so_far()
     outf.close()
+    dossier = None
+    if killed:
+        try:
+            dossier = fr.read_dossier(hb_path, stack_path)
+        except Exception:  # noqa: BLE001 — forensics must not mask the kill
+            dossier = None
+        if dossier is not None:
+            # Classify the hang by the phase the child died in: a child
+            # that never printed devices_ok but heartbeats past
+            # backend_init hung in compile/execute of the measurement, not
+            # in init — the distinction the retry policy and `tools
+            # doctor` histograms key on.
+            cls = dossier.get("phase_class", "init")
+            elapsed = time.time() - t_start
+            outcome = f"{cls}_hang_killed_after_{elapsed:.0f}s"
+    fr.cleanup_sidecars(hb_path, stack_path)
     try:
         os.remove(out_path)
     except OSError:
@@ -222,6 +316,8 @@ def run_attempt(attempt: int) -> dict | None:
         "outcome": outcome,
         "probe": probe,
     }
+    if dossier is not None:
+        log_rec["dossier"] = dossier
     if telemetry:
         log_rec["telemetry"] = {
             k: telemetry.get(k)
@@ -249,13 +345,45 @@ def run_attempt(attempt: int) -> dict | None:
     return None
 
 
+def _last_outcome() -> str:
+    """Outcome string of the newest attempt record in the log (the daemon
+    reads its own log rather than re-plumbing run_attempt's return — the
+    log is the source of truth the dossiers land in)."""
+    try:
+        with open(LOG_PATH) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return ""
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "attempt" in rec:
+            return str(rec.get("outcome", ""))
+    return ""
+
+
+def retry_sleep_for(consecutive_hangs: int) -> float:
+    """Bounded-exponential retry sleep (ISSUE 12 satellite): the base sleep
+    until 3 consecutive killed-hang attempts, then doubling per further
+    hang, capped at RETRY_SLEEP_MAX_S — evidence of a down-all-day tunnel
+    stops burning 20-minute probes every 10 minutes."""
+    if consecutive_hangs < 3:
+        return RETRY_SLEEP_S
+    return min(RETRY_SLEEP_S * (2 ** (consecutive_hangs - 2)),
+               max(RETRY_SLEEP_MAX_S, RETRY_SLEEP_S))
+
+
 def daemon_loop() -> None:
     t_daemon_start = time.time()
     deadline = t_daemon_start + DEADLINE_H * 3600
     _log({"event": "prober_start", "pid": os.getpid(),
           "init_timeout_s": INIT_TIMEOUT_S, "attempt_timeout_s": ATTEMPT_TIMEOUT_S,
-          "retry_sleep_s": RETRY_SLEEP_S, "deadline_h": DEADLINE_H})
+          "retry_sleep_s": RETRY_SLEEP_S, "retry_sleep_max_s": RETRY_SLEEP_MAX_S,
+          "deadline_h": DEADLINE_H})
     attempt = 0
+    consecutive_hangs = 0
     while time.time() < deadline:
         attempt += 1
         try:
@@ -294,7 +422,16 @@ def daemon_loop() -> None:
         ):
             return  # someone else captured a result THIS round; a stale
             # artifact from an earlier round must not stop the daemon
-        time.sleep(min(RETRY_SLEEP_S, max(0.0, deadline - time.time())))
+        if "hang_killed" in _last_outcome():
+            consecutive_hangs += 1
+        else:
+            consecutive_hangs = 0
+        sleep_s = retry_sleep_for(consecutive_hangs)
+        if sleep_s > RETRY_SLEEP_S:
+            _log({"event": "retry_escalation", "attempt": attempt,
+                  "consecutive_hangs": consecutive_hangs,
+                  "sleep_s": round(sleep_s, 1)})
+        time.sleep(min(sleep_s, max(0.0, deadline - time.time())))
     _log({"event": "prober_deadline", "attempts": attempt})
 
 
