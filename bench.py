@@ -369,6 +369,29 @@ def run_full_phase(record: dict | None = None) -> dict:
         record["lint"] = lint_summary()
     except Exception as exc:  # noqa: BLE001 — lint must not void the record
         record["lint_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # Resilience census (round 17, ISSUE 13): any PIPELINE-rung
+    # degradation (ip_device->host, device_decode->dense, lp_pallas->xla)
+    # or breaker trips during the measured run ride the artifact — a
+    # benchmark that silently served its numbers from a demoted path
+    # must say so next to the headline.  Scope is the process-global
+    # registry only: serve-tier rungs (lanestack/cell/quality) live on
+    # each engine's private registry and surface through the serve
+    # phase's own stats snapshot (lanestack_fallbacks etc.), not here.
+    try:
+        from kaminpar_tpu.resilience import breakers as _rbreakers
+        from kaminpar_tpu.resilience import faults as _rfaults
+
+        rsnap = _rbreakers.global_registry().snapshot()
+        record["resilience"] = {
+            "scope": "pipeline_rungs",
+            "demotions": rsnap["demotions"],
+            "breaker_trips": sum(
+                b["trips"] for b in rsnap["breakers"].values()
+            ),
+            "faults_injected": _rfaults.injected_total(),
+        }
+    except Exception as exc:  # noqa: BLE001 — census must not void the record
+        record["resilience_error"] = f"{type(exc).__name__}: {exc}"[:300]
     # Run-ledger inputs (round 13): top-level phase walls + the collective
     # census ride the record so the ledger entry (and the salvage path,
     # which runs in the parent process) sees the measuring process's state.
@@ -1155,7 +1178,8 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
                         "ip_backend", "initial_partitioning_wall_s",
                         "initial_partitioning_share", "ip_pool", "ip_ab",
                         "ip_ab_error", "telemetry", "telemetry_error",
-                        "phase_walls_s", "collectives", "lint"):
+                        "phase_walls_s", "collectives", "lint",
+                        "resilience", "resilience_error"):
                 if key in full_rec:
                     rec[key] = full_rec[key]
         else:

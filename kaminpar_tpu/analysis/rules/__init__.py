@@ -16,10 +16,14 @@ rng-discipline      randomness flows from utils/rng (lane keys or the
                     RandomState facade), never np.random / stdlib random
 donation-safety     buffers donated via donate_argnums are not referenced
                     after the jitted call
+error-discipline    pipeline/serve failures route through the round-17
+                    resilience taxonomy (no bare RuntimeError, dispatch-
+                    site handlers call resilience.errors.classify)
 ==================  =======================================================
 """
 
 from .donation_safety import DonationSafetyRule
+from .error_discipline import ErrorDisciplineRule
 from .phase_registry import PhaseRegistryRule
 from .rng_discipline import RngDisciplineRule
 from .runtime_isolation import RuntimeIsolationRule
@@ -31,6 +35,7 @@ ALL_RULES = (
     PhaseRegistryRule(),
     RngDisciplineRule(),
     DonationSafetyRule(),
+    ErrorDisciplineRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}
@@ -43,4 +48,5 @@ __all__ = [
     "PhaseRegistryRule",
     "RngDisciplineRule",
     "DonationSafetyRule",
+    "ErrorDisciplineRule",
 ]

@@ -727,6 +727,42 @@ class ServeContext:
 
 
 @dataclass
+class ResilienceContext:
+    """Knobs of the unified resilience layer (round 17,
+    :mod:`kaminpar_tpu.resilience`): fault injection, circuit breakers,
+    the execution watchdog.  All defaults are production-safe no-ops —
+    injection disarmed, watchdog off, breakers at the documented
+    threshold/cooldown."""
+
+    # Fault plan armed at engine start (resilience/faults.py syntax:
+    # "point[@site]:error[:key=val ...]", comma-separated).  Empty =
+    # disarmed.  Env KPTPU_FAULTS (+ KPTPU_FAULTS_SEED) arms globally and
+    # reaches child processes; an armed plan makes chaos runs replayable
+    # because injection decisions are seed-keyed, not drawn from any RNG
+    # stream.
+    fault_plan: str = ""
+    fault_seed: int = 0
+    # Consecutive failures that open a (path, cell) breaker, and how long
+    # it stays open before the half-open probe re-admits one dispatch.
+    # These govern the ENGINE's registry; pipeline sites outside any
+    # engine use the process-global registry (env-tunable via
+    # KPTPU_BREAKER_THRESHOLD / KPTPU_BREAKER_COOLDOWN_S).
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+    # Execution-watchdog deadlines (resilience/watchdog.py); 0 disables.
+    # A serve batch overrunning execute_timeout_s has its futures
+    # force-resolved with a typed ExecuteFault and its cell breaker
+    # tripped — the dispatch itself is abandoned, not cancelled (threads
+    # are not interruptible; the idempotent future discards late
+    # results).
+    execute_timeout_s: float = 0.0
+    compile_timeout_s: float = 0.0
+    # JSONL sidecar for watchdog dossiers ("" = in-memory only; the last
+    # 16 ride engine.stats()).
+    dossier_path: str = ""
+
+
+@dataclass
 class GraphCompressionContext:
     """Reference: ``GraphCompressionContext`` (kaminpar.h) — whether the
     input graph is stored compressed (graph/compressed.py, the TeraPart
@@ -783,6 +819,7 @@ class Context:
         default_factory=GraphCompressionContext
     )
     serve: ServeContext = field(default_factory=ServeContext)
+    resilience: ResilienceContext = field(default_factory=ResilienceContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
     # v-cycle mode: intermediate k values partitioned before the final k

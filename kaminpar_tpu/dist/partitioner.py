@@ -143,6 +143,11 @@ class DKaMinPar:
     def compute_partition(
         self, graph: CSRGraph, k: int, epsilon: float = 0.03
     ) -> np.ndarray:
+        from ..resilience.faults import maybe_inject
+
+        # Named "execute" injection point of the sharded tier (round 17):
+        # chaos plans target the dist dispatch with site filter "dist".
+        maybe_inject("execute", site="dist_partition")
         P = self.mesh.size
         ctx = self.ctx
         RandomState.reseed(ctx.seed)
@@ -171,9 +176,12 @@ class DKaMinPar:
         # device arrays silently downcast to int32 — exactly the workloads
         # this flag exists for would be corrupted).
         if ctx.use_64bit_ids and not jax.config.jax_enable_x64:
-            raise RuntimeError(
+            from ..resilience.errors import BackendUnavailable
+
+            raise BackendUnavailable(
                 "use_64bit_ids requires jax x64 mode "
-                "(jax.config.update('jax_enable_x64', True))"
+                "(jax.config.update('jax_enable_x64', True))",
+                site="dist_partition",
             )
         dtype = np.int64 if ctx.use_64bit_ids else np.int32
 

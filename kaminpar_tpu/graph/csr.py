@@ -208,8 +208,13 @@ class CSRGraph:
             edge_u = jnp.concatenate(
                 [self.edge_u, jnp.full(m_fill, n_pad - 1, dtype=idt)]
             )
+            from ..resilience.faults import maybe_inject
             from ..utils import compile_stats
 
+            # Named "compile" injection point (round 17): a fresh padded
+            # bucket is what triggers fresh XLA specializations — the
+            # chaos harness arms compile-class faults here.
+            maybe_inject("compile", site=f"padded_bucket:{n_pad}x{m_pad}")
             # Census of (n_pad, m_pad) shape buckets actually materialized —
             # the quantity the geometric ladder bounds to O(log n) per run.
             compile_stats.record("padded_bucket", statics=(n_pad, m_pad))
@@ -362,6 +367,120 @@ def _compute_edge_u(row_ptr, m: int):
     return jnp.asarray(np.repeat(np.arange(n, dtype=dtype), deg))
 
 
+def validate_csr_input(
+    row_ptr: np.ndarray,
+    col_idx: np.ndarray,
+    node_w: Optional[np.ndarray] = None,
+    edge_w: Optional[np.ndarray] = None,
+    *,
+    use_64bit: bool = False,
+) -> None:
+    """Facade-boundary ingestion guard (round 17 satellite): reject
+    malformed CSR input with a typed
+    :class:`~kaminpar_tpu.resilience.errors.GraphValidationError` instead
+    of letting a non-monotone row_ptr or an out-of-range column turn into
+    downstream kernel garbage (a negative degree silently corrupts
+    edge_u; an overflowing weight wraps inside int32 segment sums).
+
+    Cheap vectorized O(n + m) numpy checks — structural only; the full
+    symmetry sweep stays in :func:`validate` (the heavy assertion tier).
+    """
+    from ..resilience.errors import GraphValidationError
+
+    def _reject(msg: str):
+        raise GraphValidationError(f"rejected graph input: {msg}",
+                                   site="csr_ingest")
+
+    rp = np.asarray(row_ptr)
+    col = np.asarray(col_idx)
+    if rp.ndim != 1 or rp.size < 1:
+        _reject(f"row_ptr must be 1-D with n+1 entries, got shape {rp.shape}")
+    if col.ndim != 1:
+        _reject(f"col_idx must be 1-D, got shape {col.shape}")
+    if not np.issubdtype(rp.dtype, np.integer) or not np.issubdtype(
+        col.dtype, np.integer
+    ):
+        _reject(
+            f"row_ptr/col_idx must be integer arrays, got "
+            f"{rp.dtype}/{col.dtype}"
+        )
+    n, m = rp.size - 1, col.size
+    if rp[0] != 0:
+        _reject(f"row_ptr[0] must be 0, got {int(rp[0])}")
+    if int(rp[-1]) != m:
+        _reject(
+            f"row_ptr[-1] ({int(rp[-1])}) must equal len(col_idx) ({m})"
+        )
+    # Signed diff: on an unsigned row_ptr a descending step WRAPS instead
+    # of going negative, and the exact malformed input this guard exists
+    # for would pass.
+    drp = np.diff(rp.astype(np.int64))
+    if n > 0 and np.any(drp < 0):
+        bad = int(np.argmax(drp < 0))
+        _reject(
+            f"row_ptr is non-monotone at node {bad} "
+            f"({int(rp[bad])} -> {int(rp[bad + 1])})"
+        )
+    if m > 0:
+        cmin, cmax = int(col.min()), int(col.max())
+        if cmin < 0 or cmax >= n:
+            _reject(
+                f"col_idx out of range: [{cmin}, {cmax}] vs n={n}"
+            )
+    idt = np.int64 if use_64bit else np.int32
+    id_max = np.iinfo(idt).max
+    if m > id_max or n > id_max:
+        _reject(
+            f"n={n}/m={m} exceed the {np.dtype(idt).name} index space — "
+            "build with use_64bit_ids"
+        )
+    for name, w, count in (("node", node_w, n), ("edge", edge_w, m)):
+        if w is None:
+            continue
+        w = np.asarray(w)
+        if w.shape != (count,):
+            _reject(
+                f"{name}_weights must have shape ({count},), got {w.shape}"
+            )
+        if not np.issubdtype(w.dtype, np.integer):
+            # Float weights would be silently truncated by the index-typed
+            # cast below the facade — a different weighted problem, not a
+            # rounding detail.
+            _reject(
+                f"{name}_weights must be an integer array, got {w.dtype}"
+            )
+        if w.size and int(w.min()) < 0:
+            _reject(
+                f"negative {name} weight {int(w.min())} at index "
+                f"{int(np.argmin(w))}"
+            )
+        # Totals drive block caps / cluster-weight limits as index-typed
+        # device scalars: a total that wraps in the build's dtype corrupts
+        # every balance decision downstream.  Tiered for scale: the
+        # count*max bound clears healthy graphs with one reduction; only
+        # when it is inconclusive is the total computed — int64 where
+        # provably wrap-free, else an exact arbitrary-precision sum (an
+        # int64 accumulator alone would itself wrap, leaving the check
+        # dead for 64-bit builds).
+        if w.size:
+            wmax = int(w.max())
+            if wmax > id_max:
+                _reject(
+                    f"{name} weight {wmax} overflows "
+                    f"{np.dtype(idt).name} — build with use_64bit_ids"
+                )
+            if count * wmax > id_max:
+                if count * wmax <= np.iinfo(np.int64).max:
+                    total = int(w.astype(np.int64).sum())
+                else:
+                    total = int(np.add.reduce(w.astype(object)))
+                if total > id_max:
+                    _reject(
+                        f"total {name} weight {total} overflows "
+                        f"{np.dtype(idt).name} — build with use_64bit_ids"
+                    )
+
+
 def from_numpy_csr(
     row_ptr: np.ndarray,
     col_idx: np.ndarray,
@@ -369,7 +488,12 @@ def from_numpy_csr(
     edge_w: Optional[np.ndarray] = None,
     *,
     use_64bit: bool = False,
+    validate_input: bool = False,
 ) -> CSRGraph:
+    if validate_input:
+        validate_csr_input(
+            row_ptr, col_idx, node_w, edge_w, use_64bit=use_64bit
+        )
     idt = np.int64 if use_64bit else np.int32
     return CSRGraph(
         np.asarray(row_ptr, dtype=idt),

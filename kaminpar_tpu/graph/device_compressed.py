@@ -542,6 +542,32 @@ def build_device_view_if_eligible(ctx, cg: CompressedGraph, communities=None):
                 "falling back to the dense decode path"
             )
         return None
-    return DeviceCompressedView(
-        cg, layout_mode=ctx.parallel.device_layout_build
-    )
+    from ..resilience.breakers import global_registry
+
+    reg = global_registry()
+    breaker = reg.get("device_decode")
+    if not breaker.allow():
+        # Round 17: the decode-fused path failed its way past the breaker
+        # threshold — run this level dense (bit-identical by the round-14
+        # contract) instead of paying another doomed build; the half-open
+        # probe after the cooldown re-admits the compressed path.
+        reg.record_demotion("device_decode", "circuit breaker open")
+        return None
+    try:
+        from ..resilience.faults import maybe_inject
+
+        maybe_inject("execute", site="device_decode")
+        view = DeviceCompressedView(
+            cg, layout_mode=ctx.parallel.device_layout_build
+        )
+    except Exception as exc:  # noqa: BLE001 — the dense path is the
+        # bit-identical fallback for every view-build failure class
+        from ..resilience.errors import classify
+
+        err = classify(exc, site="device_decode")
+        breaker.record_failure()
+        reg.record_demotion("device_decode", err.failure_class)
+        return None
+    if breaker.record_success():
+        reg.record_restoration("device_decode")
+    return view

@@ -340,7 +340,13 @@ def _device_bipartition(
     bought the sequential pool.  Draws one seed from the host stream so the
     recursion stays deterministic in (graph, seed) for this backend."""
     from ..ops.bipartition import pool_bipartition_device
+    from ..resilience.faults import maybe_inject
 
+    # Injection BEFORE the seed draw: a faulted bisection then leaves the
+    # host stream exactly where a pure-host run would have it, so the
+    # ip_device -> ip_host demotion is bit-identical to running with
+    # ip_backend="host" from the start (the chaos matrix asserts this).
+    maybe_inject("execute", site="ip_device")
     seed = int(rng.integers(1 << 62))
     labels, _ = pool_bipartition_device(
         g.row_ptr, g.col_idx, g.node_w, g.edge_w, max_w, seed, ctx, final_k
@@ -367,24 +373,51 @@ def multilevel_bipartition(
     """
     ctx = ctx or InitialPartitioningContext()
     if g.n > 2 and resolve_ip_backend(ctx) == "device":
-        try:
-            return _device_bipartition(g, max_w, rng, ctx, final_k)
-        except Exception as exc:  # noqa: BLE001 — host pool is the fallback
-            import warnings
+        from ..resilience.breakers import global_registry
 
+        breaker = global_registry().get("ip_device")
+        if not breaker.allow():
+            # Breaker open (round 17): the device pool failed its way past
+            # the threshold — serve this bisection from the host pool
+            # without paying a doomed dispatch; the half-open probe after
+            # the cooldown re-admits the device path.
+            global_registry().record_demotion(
+                "ip_device", "circuit breaker open"
+            )
             from ..ops.bipartition import count_pool_fallback
 
-            # Loud + counted: a systematic kernel regression would otherwise
-            # silently serve every bisection from the host pool while bench
-            # reports ip_backend="device" (the counter rides its ip_pool
-            # census as "fallbacks").
             count_pool_fallback()
-            warnings.warn(
-                f"device IP pool failed ({type(exc).__name__}: {exc}); "
-                "falling back to the host pool for this bisection",
-                RuntimeWarning,
-                stacklevel=2,
-            )
+        else:
+            try:
+                labels = _device_bipartition(g, max_w, rng, ctx, final_k)
+                if breaker.record_success():
+                    global_registry().record_restoration("ip_device")
+                return labels
+            except Exception as exc:  # noqa: BLE001 — host pool is the fallback
+                import warnings
+
+                from ..ops.bipartition import count_pool_fallback
+                from ..resilience.errors import classify
+
+                # Loud + counted: a systematic kernel regression would
+                # otherwise silently serve every bisection from the host
+                # pool while bench reports ip_backend="device" (the counter
+                # rides its ip_pool census as "fallbacks").  The failure is
+                # classified into the round-17 taxonomy and recorded on the
+                # ip_device breaker so repeats open it instead of taxing
+                # every bisection with a doomed dispatch.
+                err = classify(exc, site="ip_device")
+                breaker.record_failure()
+                global_registry().record_demotion(
+                    "ip_device", err.failure_class, warn=False
+                )
+                count_pool_fallback()
+                warnings.warn(
+                    f"device IP pool failed ({err.failure_class}: {exc}); "
+                    "falling back to the host pool for this bisection",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
     C = ctx.coarsening_contraction_limit
     total = g.total_node_weight
 

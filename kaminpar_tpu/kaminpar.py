@@ -118,13 +118,22 @@ class KaMinPar:
         edge_weights: Optional[np.ndarray] = None,
     ) -> None:
         """ParMETIS/CSR-style input (reference: ``copy_graph``,
-        kaminpar.cc:179-218)."""
+        kaminpar.cc:179-218).
+
+        Round 17: the facade boundary validates the raw arrays — a
+        non-monotone ``row_ptr``, out-of-range column, or
+        negative/overflowing weight is rejected here with a typed
+        :class:`~kaminpar_tpu.resilience.errors.GraphValidationError`
+        instead of surfacing as kernel garbage levels later (the checks
+        are vectorized O(n + m); the full symmetry sweep remains on the
+        heavy assertion tier)."""
         from .graph.csr import from_numpy_csr
 
         self.set_graph(
             from_numpy_csr(
                 row_ptr, col_idx, node_weights, edge_weights,
                 use_64bit=self.ctx.use_64bit_ids,
+                validate_input=True,
             )
         )
 

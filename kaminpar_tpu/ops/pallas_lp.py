@@ -88,11 +88,39 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def select_lp_ops(choice: str):
+def _pallas_demoted(probe: bool) -> bool:
+    """Consult the ``lp_pallas`` circuit breaker (round 17,
+    resilience/breakers.py): True when this selection must demote to the
+    XLA twins — which are bit-identical by construction, so the demotion
+    changes wall-clock, never results.
+
+    ``probe``: only a caller that guards the dispatch AND reports the
+    outcome back to the breaker (the clusterer's ``_run_iterate``) may
+    consume the half-open probe slot; unguarded callers (the refiners)
+    use pallas only while the breaker is fully closed — otherwise a
+    still-broken kernel would crash the whole partition through a probe
+    nobody catches, and a succeeding probe would never close the
+    breaker."""
+    from ..resilience.breakers import global_registry
+
+    reg = global_registry()
+    br = reg.get("lp_pallas")
+    if br.state == "closed":
+        return False
+    if probe and br.allow():
+        return False
+    reg.record_demotion("lp_pallas", "circuit breaker open")
+    return True
+
+
+def select_lp_ops(choice: str, probe: bool = False):
     """(iterate, colored_round, colored_iterate) triple for the configured
     ``lp_kernel`` knob — the single dispatch point shared by lp_clusterer /
-    lp_refiner / clp_refiner."""
-    if resolve_lp_kernel(choice) == "pallas":
+    lp_refiner / clp_refiner.  Breaker-aware: a non-closed ``lp_pallas``
+    breaker serves the XLA twins instead (bit-identical; demotions
+    counted, reversible via half-open probing — ``probe=True`` is
+    reserved for callers that report the outcome back)."""
+    if resolve_lp_kernel(choice) == "pallas" and not _pallas_demoted(probe):
         return lp_iterate_bucketed, lp_round_colored, clp_iterate_colors
     return (
         lp_ops.lp_iterate_bucketed,
@@ -513,11 +541,13 @@ def lp_iterate_compressed(
     return state
 
 
-def select_compressed_iterate(choice: str):
+def select_compressed_iterate(choice: str, probe: bool = False):
     """The compressed-stream LP sweep loop for the ``lp_kernel`` knob —
     the decode-fused dispatch point shared by the compressed clusterer
-    path and the finest-level LP refinement pass."""
-    if resolve_lp_kernel(choice) == "pallas":
+    path and the finest-level LP refinement pass.  Breaker-aware like
+    :func:`select_lp_ops` (one ``lp_pallas`` rung covers both stream
+    variants — they share the kernel machinery that would be failing)."""
+    if resolve_lp_kernel(choice) == "pallas" and not _pallas_demoted(probe):
         return lp_iterate_compressed
     return lp_ops.lp_iterate_compressed
 

@@ -1,0 +1,299 @@
+"""Deterministic fault-injection harness (ISSUE 13 tentpole b).
+
+Named injection points are threaded through the engine, the lanestack
+runner, the device IP pool, the compressed/dist dispatch gates, and the
+sanctioned readback (``sync_stats.pull``):
+
+=============  ==========================================================
+``compile``    fresh shape-bucket materialization (graph/csr.padded) and
+               the engine's per-cell warmup solves
+``execute``    pipeline dispatch sites — the engine's per-request solve,
+               the lane-stacked batch runner, the device IP pool, the
+               device-decode view gate, the dist partitioner entry
+``readback``   every counted blocking device->host transfer
+``queue-admit``  serve admission, before the request is queued
+``warmup``     the engine warmup pass entry
+=============  ==========================================================
+
+A *fault plan* is a comma-separated list of specs::
+
+    point[@site]:error[:key=value ...]
+
+    execute:execute-fault:n=2          # fail the first 2 execute hits
+    execute@lanestack:execute-fault    # only sites containing "lanestack"
+    queue-admit:capacity-exceeded:after=1:n=1
+    execute:execute-fault:p=0.5        # seed-keyed coin per hit
+    execute:execute-fault:delay=0.3    # sleep first (simulated hang,
+                                       # exercises the watchdog)
+
+keys: ``n`` (max injections; 0 = unlimited, default 1), ``after`` (pass
+through the first N matching hits), ``p`` (injection probability —
+decided by a **seed-keyed hash** of (plan seed, spec index, hit index),
+so a chaos run replays bit-for-bit under the same plan + seed and
+reshuffles under a different seed; no RNG stream is consumed), ``delay``
+(seconds to sleep before raising — a bounded hang the execution watchdog
+must catch).  ``error`` is a failure-class name from
+:data:`kaminpar_tpu.resilience.errors.FAILURE_CLASSES`.
+
+Armed via :func:`arm` / the :func:`injected_faults` context manager
+(``Context.resilience.fault_plan`` arms at engine start) or env
+``KPTPU_FAULTS`` (+ ``KPTPU_FAULTS_SEED``), which reaches child
+processes.  Disarmed, :func:`maybe_inject` is one module-flag read —
+the production hot path pays nothing measurable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .errors import FAILURE_CLASSES, ResilienceError
+
+INJECTION_POINTS = ("compile", "execute", "readback", "queue-admit", "warmup")
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: where, what, when."""
+
+    point: str
+    error: str = "execute-fault"
+    site: str = ""        # substring filter on the call site ("" = any)
+    count: int = 1        # max injections; 0 = unlimited
+    after: int = 0        # matching hits to pass through first
+    p: float = 1.0        # seed-keyed injection probability
+    delay_s: float = 0.0  # sleep before raising (simulated hang)
+    # Mutable counters (per armed plan):
+    hits: int = field(default=0, compare=False)
+    injected: int = field(default=0, compare=False)
+
+    def validate(self) -> "FaultSpec":
+        if self.point not in INJECTION_POINTS:
+            raise ValueError(
+                f"unknown injection point {self.point!r} "
+                f"(expected one of {INJECTION_POINTS})"
+            )
+        if self.error not in FAILURE_CLASSES:
+            raise ValueError(
+                f"unknown failure class {self.error!r} "
+                f"(expected one of {tuple(FAILURE_CLASSES)})"
+            )
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"p={self.p} outside [0, 1]")
+        return self
+
+
+@dataclass
+class FaultPlan:
+    """A parsed, seed-keyed set of :class:`FaultSpec`."""
+
+    specs: List[FaultSpec]
+    seed: int = 0
+    source: str = ""
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        specs: List[FaultSpec] = []
+        for raw in text.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split(":")
+            point, _, site = parts[0].strip().partition("@")
+            spec = FaultSpec(point=point.strip(), site=site.strip())
+            if len(parts) > 1 and parts[1].strip():
+                spec.error = parts[1].strip()
+            for kv in parts[2:]:
+                key, _, val = kv.partition("=")
+                key = key.strip()
+                if key == "n":
+                    spec.count = int(val)
+                elif key == "after":
+                    spec.after = int(val)
+                elif key == "p":
+                    spec.p = float(val)
+                elif key == "delay":
+                    spec.delay_s = float(val)
+                else:
+                    raise ValueError(f"unknown fault-spec key {key!r} in {raw!r}")
+            specs.append(spec.validate())
+        return cls(specs=specs, seed=int(seed), source=text)
+
+
+_lock = threading.Lock()
+_armed: List[Optional[FaultPlan]] = [None]
+_env_checked = [False]
+#: process-lifetime census per injection point: [hits, injected]
+_point_census: Dict[str, List[int]] = {}
+
+
+def _coin(seed: int, spec_idx: int, hit: int, p: float) -> bool:
+    """Seed-keyed deterministic coin: the decision for hit ``hit`` of spec
+    ``spec_idx`` is a pure function of (seed, spec_idx, hit) — replayable
+    chaos, no RNG stream consumed (rng-discipline stays intact)."""
+    if p >= 1.0:
+        return True
+    if p <= 0.0:
+        return False
+    digest = hashlib.blake2b(
+        f"{seed}:{spec_idx}:{hit}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big") / float(1 << 64) < p
+
+
+def arm(plan: FaultPlan) -> None:
+    """Arm a plan process-wide (replacing any armed plan)."""
+    with _lock:
+        _armed[0] = plan
+        _env_checked[0] = True  # an explicit plan outranks the env
+
+
+def disarm() -> None:
+    with _lock:
+        _armed[0] = None
+        _env_checked[0] = True
+
+
+def reset() -> None:
+    """Disarm and zero the census (tests); re-enables env discovery."""
+    with _lock:
+        _armed[0] = None
+        _env_checked[0] = False
+        _point_census.clear()
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    text = os.environ.get("KPTPU_FAULTS", "")
+    if not text:
+        return None
+    seed = int(os.environ.get("KPTPU_FAULTS_SEED", "0") or 0)
+    plan = FaultPlan.parse(text, seed=seed)
+    plan.source = f"env:{text}"
+    return plan
+
+
+def active_plan() -> Optional[FaultPlan]:
+    with _lock:
+        if not _env_checked[0]:
+            _env_checked[0] = True
+            try:
+                _armed[0] = plan_from_env()
+            except ValueError:
+                import warnings
+
+                warnings.warn(
+                    f"kaminpar_tpu resilience: unparseable KPTPU_FAULTS="
+                    f"{os.environ.get('KPTPU_FAULTS')!r} ignored",
+                    RuntimeWarning,
+                )
+                _armed[0] = None
+        return _armed[0]
+
+
+@contextmanager
+def injected_faults(plan):
+    """Arm ``plan`` (a :class:`FaultPlan` or a spec string) for the block;
+    restores the previous arming on exit — the chaos tests' entry."""
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    with _lock:
+        prev, prev_env = _armed[0], _env_checked[0]
+        _armed[0] = plan
+        _env_checked[0] = True
+    try:
+        yield plan
+    finally:
+        with _lock:
+            _armed[0], _env_checked[0] = prev, prev_env
+
+
+def maybe_inject(point: str, site: str = "") -> None:
+    """Raise the armed typed fault for ``point`` if the plan says so.
+
+    Disarmed (the production default), this is a single list read.  The
+    raised error carries ``injected=True`` and the site string, and the
+    per-point census (:func:`snapshot`) counts both hits and injections
+    so chaos tests can assert counters match the plan exactly.
+    """
+    if _armed[0] is None and _env_checked[0]:
+        return
+    plan = active_plan()
+    if plan is None:
+        return
+    fire: Optional[FaultSpec] = None
+    with _lock:
+        row = _point_census.setdefault(point, [0, 0])
+        row[0] += 1
+        for idx, spec in enumerate(plan.specs):
+            if spec.point != point:
+                continue
+            if spec.site and spec.site not in site:
+                continue
+            spec.hits += 1
+            if spec.hits <= spec.after:
+                continue
+            if spec.count and spec.injected >= spec.count:
+                continue
+            if not _coin(plan.seed, idx, spec.hits, spec.p):
+                continue
+            spec.injected += 1
+            row[1] += 1
+            fire = spec
+            break
+    if fire is None:
+        return
+    if fire.delay_s > 0:
+        time.sleep(fire.delay_s)
+    err_cls = FAILURE_CLASSES[fire.error]
+    raise _construct(err_cls, fire, point, site)
+
+
+def _construct(err_cls, spec: FaultSpec, point: str, site: str) -> ResilienceError:
+    message = (
+        f"injected {spec.error} at {point}"
+        + (f" (site {site})" if site else "")
+        + f" [#{spec.injected}]"
+    )
+    from .errors import PoisonedCell
+
+    if err_cls is PoisonedCell:
+        err = PoisonedCell((), 0.0, site=site, injected=True)
+    else:
+        err = err_cls(message, site=site, injected=True)
+    return err
+
+
+def snapshot() -> dict:
+    """{armed, source, seed, points: {point: {hits, injected}},
+    specs: [...]} — the chaos census the engine stats / the ``tools
+    chaos`` soak embed."""
+    with _lock:
+        plan = _armed[0]
+        out = {
+            "armed": plan is not None,
+            "source": plan.source if plan else "",
+            "seed": plan.seed if plan else 0,
+            "points": {
+                pt: {"hits": row[0], "injected": row[1]}
+                for pt, row in sorted(_point_census.items())
+            },
+            "specs": [
+                {
+                    "point": s.point, "site": s.site, "error": s.error,
+                    "count": s.count, "after": s.after, "p": s.p,
+                    "hits": s.hits, "injected": s.injected,
+                }
+                for s in (plan.specs if plan else [])
+            ],
+        }
+    return out
+
+
+def injected_total() -> int:
+    with _lock:
+        return sum(row[1] for row in _point_census.values())

@@ -1,0 +1,182 @@
+"""Execution watchdog (ISSUE 13 tentpole d).
+
+The round-16 flight recorder proves *what a dead child was doing* after
+the parent's SIGKILL; it cannot save an in-process serve engine whose
+dispatcher thread hangs inside a compile or execute — clients block on
+futures forever and the queue wedges.  The watchdog closes that gap:
+
+- :meth:`ExecutionWatchdog.guard` wraps a compile/execute with a
+  deadline.  A monitor timer fires if the block overruns, assembles a
+  flight-recorder-style **dossier** (the dying phase from the sync-stats
+  phase board — the same board the heartbeat thread reads — plus every
+  thread's Python stack via ``faulthandler.dump_traceback`` and RSS) and
+  invokes the caller's ``on_timeout`` so the hang becomes a **breaker
+  trip + typed future resolution** instead of a killed process.
+- A Python thread cannot be interrupted, so the hung dispatch is
+  *abandoned*, not cancelled: its futures are force-resolved with
+  :class:`~kaminpar_tpu.resilience.errors.ExecuteFault` /
+  :class:`CompileTimeout`, the (path, cell) breaker opens, and — should
+  the computation eventually return — the idempotent future discards
+  the late result.  What the watchdog proves that the flight recorder
+  alone cannot: *recovery*, not just attribution (TPU_NOTES round 17).
+
+Pure stdlib at import time (threading + faulthandler); reads the phase
+board lazily like telemetry/flight_recorder.py.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+
+def _board_phases() -> Dict[str, str]:
+    """Best-effort read of the sync-stats phase board — identical
+    semantics to the flight recorder's heartbeat attribution."""
+    try:
+        sync_stats = sys.modules.get("kaminpar_tpu.utils.sync_stats")
+        if sync_stats is None:
+            return {}
+        return {k: v for k, v in sync_stats.current_phases().items() if v}
+    except Exception:  # noqa: BLE001 — forensics must never raise
+        return {}
+
+
+def _all_stacks(tail_lines: int = 20) -> List[str]:
+    """Every thread's Python stack, monitor-thread-safe.  (faulthandler
+    needs a real file descriptor; ``sys._current_frames`` gives the same
+    forensic picture into plain strings.)  The tail limit applies PER
+    THREAD — a global tail would keep only whichever thread happened to
+    be iterated last and usually drop the hung dispatcher, the one stack
+    the dossier exists to capture."""
+    try:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        lines: List[str] = []
+        for tid, frame in sys._current_frames().items():
+            stack = [
+                ln.rstrip()
+                for entry in traceback.format_stack(frame)
+                for ln in entry.splitlines()
+            ]
+            lines.append(f"Thread {names.get(tid, tid)}:")
+            lines.extend(stack[-int(tail_lines):])
+    except Exception:  # noqa: BLE001
+        return []
+    return lines
+
+
+class ExecutionWatchdog:
+    """Deadline guard over compile/execute dispatches.
+
+    One instance per engine (or per offline driver); dossiers of fired
+    guards accumulate on :attr:`dossiers` (bounded) and ride
+    ``engine.stats()['resilience']['watchdog']``.
+    """
+
+    MAX_DOSSIERS = 16
+
+    def __init__(self, dossier_path: str = ""):
+        self.dossier_path = dossier_path
+        self.fired = 0
+        self.guards = 0
+        self.dossiers: List[dict] = []
+        self._lock = threading.Lock()
+
+    def _record(self, dossier: dict) -> None:
+        with self._lock:
+            self.fired += 1
+            self.dossiers.append(dossier)
+            del self.dossiers[: -self.MAX_DOSSIERS]
+        if self.dossier_path:
+            try:
+                import json
+
+                with open(self.dossier_path, "a") as fh:
+                    fh.write(json.dumps(dossier) + "\n")
+            except Exception:  # noqa: BLE001 — forensics must not kill serve
+                pass
+
+    @contextmanager
+    def guard(
+        self,
+        phase: str,
+        timeout_s: float,
+        on_timeout: Optional[Callable[[dict], None]] = None,
+    ):
+        """Run the block under a deadline; ``timeout_s <= 0`` disarms.
+
+        On overrun the monitor thread assembles the dossier and calls
+        ``on_timeout(dossier)`` (once) — typically: trip the breaker and
+        force-resolve the in-flight futures.  The guarded block keeps
+        running (threads are not interruptible); its exit is recorded in
+        the dossier's ``completed_late`` counter if it ever comes."""
+        self.guards += 1
+        if timeout_s <= 0:
+            yield
+            return
+        done = threading.Event()
+        fired = threading.Event()
+
+        def _monitor():
+            if done.wait(timeout_s):
+                return
+            fired.set()
+            try:
+                from ..telemetry.flight_recorder import _rss_bytes, classify_phase
+            except Exception:  # noqa: BLE001 — standalone fallback
+                def _rss_bytes():  # type: ignore[misc]
+                    return None
+
+                def classify_phase(p):  # type: ignore[misc]
+                    return "execute"
+
+            phases = _board_phases()
+            dossier = {
+                "phase": phase,
+                "phase_class": classify_phase(phase),
+                "timeout_s": timeout_s,
+                "t_mono_s": round(time.monotonic(), 3),
+                "board_phases": phases,
+                "rss_bytes": _rss_bytes(),
+                "stack_tail": _all_stacks(),
+                "completed_late": False,
+            }
+            self._record(dossier)
+            if on_timeout is not None:
+                try:
+                    on_timeout(dossier)
+                except Exception:  # noqa: BLE001 — the timeout callback
+                    # must never take down the monitor thread
+                    pass
+
+        monitor = threading.Thread(
+            target=_monitor, name="kpt-watchdog", daemon=True
+        )
+        monitor.start()
+        try:
+            yield
+        finally:
+            done.set()
+            if fired.is_set():
+                # The abandoned dispatch eventually returned (or raised):
+                # note it so operators can distinguish a slow cell from a
+                # true hang.
+                with self._lock:
+                    if self.dossiers:
+                        self.dossiers[-1]["completed_late"] = True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "guards": self.guards,
+                "fired": self.fired,
+                "dossiers": [
+                    {k: d[k] for k in ("phase", "phase_class", "timeout_s",
+                                       "completed_late")}
+                    for d in self.dossiers
+                ],
+            }

@@ -101,13 +101,25 @@ class ServeFuture:
             self._started = True
             return True
 
-    def _resolve(self, result: ServeResult) -> None:
-        self._result = result
-        self._ev.set()
+    def _resolve(self, result: ServeResult) -> bool:
+        """First resolution wins (round 17): the execution watchdog may
+        force-reject a hung batch's futures from its monitor thread; if
+        the abandoned dispatch later returns, its late result is
+        discarded here.  Returns whether THIS call resolved the future."""
+        with self._lock:
+            if self._ev.is_set():
+                return False
+            self._result = result
+            self._ev.set()
+            return True
 
-    def _reject(self, error: BaseException) -> None:
-        self._error = error
-        self._ev.set()
+    def _reject(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._ev.is_set():
+                return False
+            self._error = error
+            self._ev.set()
+            return True
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -141,6 +153,16 @@ class ServeRequest:
     max_block_weights: Optional[Sequence[int]] = None
     min_epsilon: float = 0.0
     min_block_weights: Optional[Sequence[int]] = None
+    # Quality tier (round 17): "strong" = the engine's full pipeline;
+    # "fast" = the trimmed-refinement solver.  The quality_strong ->
+    # quality_fast ladder rung demotes strong requests per shape cell
+    # under capacity-class failures (counted, reversible).
+    quality: str = "strong"
+    # The tier that actually served the request ("" until dispatch; may
+    # differ from ``quality`` under a quality_strong demotion) — warm
+    # accounting is tier-keyed, because the two tiers compile different
+    # executable sets.
+    quality_served: str = ""
     # Filled during execution:
     partition: Optional[np.ndarray] = None
     caps: Optional[np.ndarray] = None
@@ -210,7 +232,10 @@ class PartitionEngine:
             )
         self._queue = BoundedServeQueue(self.serve.queue_bound)
         self.stats_ = ServeStats()
-        self._warm_nk: set = set()     # (n_bucket, k) — warm-hit accounting
+        # (n_bucket, k, tier) — warm-hit accounting, keyed by the quality
+        # tier that served the cell (the two tiers compile different
+        # executable sets, so a fast-served cell is not warm for strong).
+        self._warm_nk: set = set()
         self._warm_cells: set = set()  # exact (n_bucket, m_bucket, k) cells
         # Lane-stack shape keys THIS engine has already traced (warmup rows
         # or a served batch): (LaneStackReport.layout_key, k, epsilon).
@@ -219,13 +244,35 @@ class PartitionEngine:
         # engines/facades in the process (the compile census is
         # process-global).
         self._warm_stack_keys: set = set()
-        # Lane-stack circuit breaker: consecutive *execution* failures
-        # (not eligibility fallbacks) latch the stacked path off for this
-        # engine so a deterministic mid-pipeline bug doesn't tax every
-        # batch with a doomed stacked attempt before its per-graph rerun.
-        self._lanestack_failures = 0
-        self._lanestack_broken = False
+        # Unified resilience layer (round 17, kaminpar_tpu/resilience):
+        # this engine owns a private breaker registry for the serve-tier
+        # ladder rungs — per-cell "lanestack" breakers (generalizing the
+        # round-11 engine-global latch, now reversible via half-open
+        # probing), per-cell "cell" breakers (a poisoned shape cell
+        # fast-fails new admissions instead of wedging the queue), and
+        # per-cell "quality_strong" breakers (capacity-class failures
+        # demote strong requests to the fast tier).  Pipeline rungs
+        # (lp_pallas, ip_device, device_decode) live on the process-global
+        # registry.  The watchdog bounds hung executes.
+        from ..resilience.breakers import BreakerRegistry
+        from ..resilience.watchdog import ExecutionWatchdog
+
+        self.resilience = ctx.resilience
+        self.breakers = BreakerRegistry(
+            threshold=self.resilience.breaker_threshold,
+            cooldown_s=self.resilience.breaker_cooldown_s,
+        )
+        self.watchdog = ExecutionWatchdog(self.resilience.dossier_path)
         self.warmup_report: List[dict] = []
+        # Requests currently being executed by the dispatcher (the bounded
+        # shutdown force-resolves these when the worker dies mid-batch).
+        self._inflight: List[ServeRequest] = []
+        # Lazily-built trimmed-refinement solver serving quality="fast"
+        # requests and quality_strong demotions.
+        self._fast_solver = None
+        # Whether THIS engine armed the process-wide fault plan (start()
+        # arms, shutdown() disarms — injections must not outlive us).
+        self._armed_faults = False
         # Admission-preflight ceiling (ISSUE 12): resolved lazily at start()
         # — explicit override > measured allocator limit > device-kind
         # table; None disables (no ceiling is knowable, e.g. CPU without
@@ -266,9 +313,42 @@ class PartitionEngine:
             from ..utils import compile_stats
 
             compile_stats.enable_compile_time_tracking()
-            self._resolve_capacity_ceiling()
-            if warmup:
-                self._warmup()
+            if self.resilience.fault_plan:
+                # Arm the context's chaos plan process-wide (seed-keyed, so
+                # the run replays bit-for-bit); env KPTPU_FAULTS outranks
+                # it by arming earlier via the lazy env discovery.  The
+                # engine remembers that IT armed and disarms at shutdown —
+                # chaos injections must not outlive the engine and leak
+                # into unrelated engines/pipelines in the process.
+                from ..resilience import faults
+
+                if faults.active_plan() is None:
+                    faults.arm(faults.FaultPlan.parse(
+                        self.resilience.fault_plan,
+                        seed=self.resilience.fault_seed,
+                    ))
+                    self._armed_faults = True
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "kaminpar_tpu serve: a fault plan is already "
+                        "armed in this process — this engine's "
+                        "resilience.fault_plan is ignored (one plan per "
+                        "process; disarm the active one first).",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+            try:
+                self._resolve_capacity_ceiling()
+                if warmup:
+                    self._warmup()
+            except BaseException:
+                # start() failing after arming must not leak the chaos
+                # plan into the process (shutdown's disarm is unreachable
+                # for a never-running engine).
+                self._disarm_faults()
+                raise
             self._running = True
             self._thread = threading.Thread(
                 target=self._loop, name="kaminpar-serve-dispatch", daemon=True
@@ -366,6 +446,16 @@ class PartitionEngine:
             return rung_graphs[n]
 
         compile_stats.enable_compile_time_tracking()
+        from ..resilience.errors import ResilienceError, classify
+        from ..resilience.faults import maybe_inject
+
+        try:
+            # Named "warmup" injection point: a warmup-pass fault degrades
+            # the engine to cold-start serving, never fails start().
+            maybe_inject("warmup", site="engine_warmup")
+        except ResilienceError as exc:
+            self._warmup_fault(exc, "warmup pass")
+            return
         for n in self.serve.warm_ladder:
             for k in self.serve.warm_ks:
                 scale, g = rung_graph(n)
@@ -374,8 +464,22 @@ class PartitionEngine:
                 cell = shape_cell(g, k)
                 before = compile_stats.compile_time_snapshot()
                 t0 = time.perf_counter()
-                self._solver.set_graph(g)
-                self._solver.compute_partition(int(k), 0.03)
+                try:
+                    maybe_inject("compile", site=f"warmup_cell:{n}:{k}")
+                    with self.watchdog.guard(
+                        "warmup_compile", self.resilience.compile_timeout_s,
+                        on_timeout=lambda d, c=cell: self._on_hang(c, d),
+                    ):
+                        self._solver.set_graph(g)
+                        self._solver.compute_partition(int(k), 0.03)
+                except Exception as exc:  # noqa: BLE001 — one poisoned warm
+                    # cell must not abort the ladder; classify, count,
+                    # keep warming the rest.
+                    self._warmup_fault(
+                        classify(exc, site=f"warmup_cell:{n}:{k}"),
+                        f"warm cell (n={n}, k={k})",
+                    )
+                    continue
                 wall = time.perf_counter() - t0
                 after = compile_stats.compile_time_snapshot()
                 row = {
@@ -414,6 +518,47 @@ class PartitionEngine:
         ]
         if execs:
             self.stats_.seed_service_time(float(np.mean(execs)))
+
+    def _warmup_fault(self, err, what: str) -> None:
+        """Count + surface one contained warmup failure (typed; the engine
+        serves cold-start for whatever was not warmed)."""
+        import warnings
+
+        self.stats_.bump("warmup_faults")
+        warnings.warn(
+            f"kaminpar_tpu serve: {what} failed during warmup "
+            f"({err.failure_class}: {err}) — continuing; unwarmed cells "
+            "pay their compile on first request.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+    def _on_hang(self, cell: ShapeCell, dossier: dict,
+                 live: Optional[List[ServeRequest]] = None) -> None:
+        """Watchdog timeout callback (monitor thread): convert the hang
+        into a breaker trip + typed future resolutions instead of a
+        killed process (round 17 tentpole d).  The hung dispatch itself
+        is abandoned — the idempotent futures discard its late result."""
+        from ..resilience.errors import ExecuteFault
+
+        self.stats_.bump("watchdog_timeouts")
+        key = (cell.n_bucket, cell.m_bucket, cell.k)
+        # Force the trip (not one counted failure): each further probe of
+        # a hung cell wedges the single dispatcher thread for a full
+        # deadline — one observed hang is conclusive, the next request
+        # fast-fails with PoisonedCell until the cooldown's half-open
+        # probe.
+        self.breakers.get("cell", key).trip()
+        for req in (live or []):
+            if req.future._reject(ExecuteFault(
+                f"request {req.id} abandoned: {dossier['phase']} exceeded "
+                f"the {dossier['timeout_s']}s watchdog deadline in cell "
+                f"{key} (dossier on engine.stats()['resilience'])",
+                site="watchdog",
+            )):
+                self.stats_.record_request(
+                    time.monotonic() - req.enqueue_t, 0.0, failed=True
+                )
 
     def _harvest_cell_census(self, cell: ShapeCell) -> dict:
         """Harvest the executable census of one warm shape cell via the
@@ -611,9 +756,9 @@ class PartitionEngine:
                     "trace_s": round(after["trace_s"] - before["trace_s"], 3),
                 })
 
-    def _note_warm(self, cell: ShapeCell) -> None:
+    def _note_warm(self, cell: ShapeCell, tier: str = "strong") -> None:
         self._warm_cells.add(cell)
-        self._warm_nk.add((cell.n_bucket, cell.k))
+        self._warm_nk.add((cell.n_bucket, cell.k, tier))
 
     @property
     def running(self) -> bool:
@@ -630,7 +775,14 @@ class PartitionEngine:
     def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None) -> None:
         """Stop the engine.  ``drain=True`` serves everything already
         queued first; ``drain=False`` rejects queued work with
-        :class:`EngineStoppedError`.  Idempotent."""
+        :class:`EngineStoppedError`.  Idempotent.
+
+        Round 17 satellite: the drain is BOUNDED — if the dispatcher
+        thread dies or hangs mid-batch, everything still unresolved
+        (queued + in-flight) is force-resolved with a typed
+        :class:`~kaminpar_tpu.resilience.errors.WorkerHung` after
+        ``timeout_s`` (default ``ServeContext.drain_timeout_s``) instead
+        of blocking callers forever."""
         with self._lock:
             if not self._running:
                 return
@@ -644,9 +796,48 @@ class PartitionEngine:
             self._gate.set()
             thread = self._thread
         if thread is not None:
-            thread.join(timeout_s or self.serve.drain_timeout_s)
+            # `is not None`, not truthiness: an explicit timeout_s=0.0
+            # means "force-resolve immediately", not "use the default".
+            budget = (
+                timeout_s if timeout_s is not None
+                else self.serve.drain_timeout_s
+            )
+            thread.join(budget)
+            if thread.is_alive():
+                # The worker is hung (or wedged on a poisoned batch): the
+                # drain contract still holds — every outstanding future is
+                # resolved, with a typed error naming the cause.
+                from ..resilience.errors import WorkerHung
+
+                stuck = list(self._queue.drain_items())
+                with self._lock:
+                    stuck.extend(self._inflight)
+                hung = 0
+                for req in stuck:
+                    if req.future._reject(WorkerHung(
+                        f"request {req.id} unresolved: the dispatcher "
+                        "thread did not finish draining within "
+                        f"{budget}s "
+                        "(worker dead or hung mid-batch)",
+                        site="shutdown",
+                    )):
+                        hung += 1
+                        self.stats_.record_request(
+                            time.monotonic() - req.enqueue_t, 0.0, failed=True
+                        )
+                if hung:
+                    self.stats_.bump("worker_hung", hung)
+        self._disarm_faults()
         with self._lock:
             self._running = False
+
+    def _disarm_faults(self) -> None:
+        """Disarm the process-wide fault plan iff THIS engine armed it."""
+        if self._armed_faults:
+            from ..resilience import faults
+
+            faults.disarm()
+            self._armed_faults = False
 
     def __enter__(self) -> "PartitionEngine":
         return self.start()
@@ -666,18 +857,46 @@ class PartitionEngine:
         max_block_weights: Optional[Sequence[int]] = None,
         min_epsilon: float = 0.0,
         min_block_weights: Optional[Sequence[int]] = None,
+        quality: str = "strong",
     ) -> ServeFuture:
         """Enqueue one partition request; returns a :class:`ServeFuture`.
 
-        Raises :class:`EngineStoppedError` when not running and
+        Raises :class:`EngineStoppedError` when not running,
         :class:`QueueFullError` (with ``retry_after_s``) when admission
-        control rejects the request."""
+        control rejects the request, and
+        :class:`~kaminpar_tpu.resilience.errors.PoisonedCell` (with
+        ``retry_after_s``) when the request's shape cell tripped its
+        circuit breaker — a deterministically failing cell fast-fails at
+        admission instead of wedging the queue (round 17).
+
+        ``quality``: "strong" (the engine's full pipeline) or "fast"
+        (trimmed refinement — the tiered-SLO knob; strong requests can be
+        demoted per cell by the quality_strong ladder rung under
+        capacity-class failures)."""
+        if quality not in ("strong", "fast"):
+            raise ValueError(
+                f"quality must be 'strong' or 'fast', got {quality!r}"
+            )
         if not self._running:
             raise EngineStoppedError("engine not started (call start())")
         self.stats_.bump("submitted")
+        from ..resilience.errors import PoisonedCell
+        from ..resilience.faults import maybe_inject
+
+        maybe_inject("queue-admit", site="submit")
         self._capacity_preflight(graph, k)
         cell = shape_cell(graph, k)
-        warm = (cell.n_bucket, int(k)) in self._warm_nk
+        cell_key = (cell.n_bucket, cell.m_bucket, cell.k)
+        cell_breaker = self.breakers.get("cell", cell_key)
+        if not cell_breaker.allow():
+            # Poisoned cell: reject fast with the cooldown as the retry
+            # hint; the post-cooldown half-open probe re-admits ONE
+            # request, and its success restores the cell.
+            self.stats_.bump("rejected_poisoned")
+            raise PoisonedCell(
+                cell_key, cell_breaker.retry_after_s(), site="submit"
+            )
+        warm = (cell.n_bucket, int(k), quality) in self._warm_nk
         self.stats_.record_warm(warm)
         if deadline_ms is None:
             deadline_ms = self.serve.default_deadline_ms
@@ -695,6 +914,7 @@ class PartitionEngine:
             max_block_weights=max_block_weights,
             min_epsilon=float(min_epsilon),
             min_block_weights=min_block_weights,
+            quality=quality,
         )
         req.future.request_id = req.id
         from ..telemetry import trace as ttrace
@@ -731,6 +951,7 @@ class PartitionEngine:
         max_block_weights: Optional[Sequence[int]] = None,
         min_epsilon: float = 0.0,
         min_block_weights: Optional[Sequence[int]] = None,
+        quality: str = "strong",
     ) -> np.ndarray:
         """Synchronous convenience wrapper: submit + wait, returning the
         (n,) block array — the facade delegates here when constructed with
@@ -744,6 +965,7 @@ class PartitionEngine:
             max_block_weights=max_block_weights,
             min_epsilon=min_epsilon,
             min_block_weights=min_block_weights,
+            quality=quality,
         )
         return fut.result().partition
 
@@ -760,10 +982,19 @@ class PartitionEngine:
             try:
                 self._execute_batch(batch)
             except Exception as exc:  # noqa: BLE001 — a poisoned batch must
-                # not kill the dispatcher; reject its requests instead.
+                # not kill the dispatcher; classify the failure (round 17
+                # taxonomy) and reject its requests with the typed error.
+                from ..resilience.errors import classify
+
+                err = classify(exc, site="dispatch")
+                if batch:
+                    key = (
+                        batch[0].cell.n_bucket, batch[0].cell.m_bucket,
+                        batch[0].cell.k,
+                    )
+                    self.breakers.get("cell", key).record_failure()
                 for req in batch:
-                    if not req.future.done():
-                        req.future._reject(ServeError(f"batch failed: {exc!r}"))
+                    if req.future._reject(err):
                         self.stats_.record_request(
                             time.monotonic() - req.enqueue_t, 0.0, failed=True
                         )
@@ -797,9 +1028,24 @@ class PartitionEngine:
             rec.begin("serve.batch", occupancy=len(live), k=cell.k,
                       n_bucket=cell.n_bucket, m_bucket=cell.m_bucket)
 
+        with self._lock:
+            self._inflight = list(live)
         try:
-            self._execute_live(live)
+            # Execution watchdog (round 17): a hung compile/execute inside
+            # this batch has its futures force-resolved with a typed
+            # ExecuteFault and its cell breaker tripped after
+            # resilience.execute_timeout_s (0 disarms) — the dispatch is
+            # abandoned, not cancelled, and its late result discarded by
+            # the idempotent futures.
+            with self.watchdog.guard(
+                "serve_execute", self.resilience.execute_timeout_s,
+                on_timeout=lambda d, c=live[0].cell, lv=list(live):
+                    self._on_hang(c, d, lv),
+            ):
+                self._execute_live(live)
         finally:
+            with self._lock:
+                self._inflight = []
             if rec is not None:
                 rec.end("serve.batch")
                 rec.counter("serve.queue", {"depth": len(self._queue)})
@@ -842,19 +1088,30 @@ class PartitionEngine:
         mode = self._lane_stack_mode()
         if mode == "off" or (mode != "on" and len(live) < 2):
             return None
-        if self._lanestack_broken:
-            # Breaker tripped (consecutive execution failures): skip the
-            # doomed stacked attempt; the counter keeps surfacing the lost
-            # parallelism, the trip itself already warned.
+        cell_key = (
+            live[0].cell.n_bucket, live[0].cell.m_bucket, live[0].cell.k
+        )
+        breaker = self.breakers.get("lanestack", cell_key)
+        if not breaker.allow():
+            # Breaker open (round 17, generalizing the round-11 latch):
+            # skip the doomed stacked attempt — the demotion counter keeps
+            # surfacing the lost parallelism, the trip itself already
+            # warned, and the post-cooldown half-open probe re-arms the
+            # stacked path without an engine restart.
             self.stats_.bump("lanestack_fallbacks")
+            self.breakers.record_demotion(
+                "lanestack", "circuit breaker open", warn=False
+            )
             return None
-        # Per-request constraint overrides are outside the lockstep
-        # envelope: the stacked pipeline computes every lane's caps from
-        # (k, epsilon), which the shape cell already holds fixed.
+        # Per-request constraint overrides (and non-strong quality tiers)
+        # are outside the lockstep envelope: the stacked pipeline computes
+        # every lane's caps from (k, epsilon), which the shape cell
+        # already holds fixed, on the full-refinement chain.
         if any(
             r.max_block_weights is not None
             or r.min_block_weights is not None
             or r.min_epsilon
+            or r.quality != "strong"
             for r in live
         ) or len({r.epsilon for r in live}) != 1:
             self._lanestack_fallback(
@@ -885,28 +1142,43 @@ class PartitionEngine:
             # not reject a batch the per-graph loop can still serve; fall
             # back LOUDLY in every mode (the per-graph results remain
             # correct, the warning and counter surface the lost
-            # parallelism).
+            # parallelism).  The failure is classified and recorded on the
+            # per-cell lanestack breaker; tripping it skips the doomed
+            # attempt on later batches until the half-open probe recovers.
+            from ..resilience.errors import classify
+
+            err = classify(exc, site="lanestack")
             self._lanestack_fallback(
-                f"lane-stacked execution failed ({type(exc).__name__}: {exc})",
+                f"lane-stacked execution failed "
+                f"({err.failure_class}: {exc})",
                 warn=True,
             )
-            self._lanestack_failures += 1
-            if self._lanestack_failures >= 3 and not self._lanestack_broken:
-                self._lanestack_broken = True
+            self.breakers.record_demotion(
+                "lanestack", err.failure_class, warn=False
+            )
+            if breaker.record_failure():
                 import warnings
 
                 warnings.warn(
                     "kaminpar_tpu serve: lane-stacked execution failed on "
-                    f"{self._lanestack_failures} consecutive batches — "
-                    "disabling the stacked path for this engine (the "
-                    "per-graph loop keeps serving; restart the engine "
-                    "process to re-arm).",
+                    f"{breaker.threshold} consecutive batches in cell "
+                    f"{cell_key} — disabling the stacked path for this "
+                    "cell (the per-graph loop keeps serving; a half-open "
+                    f"probe re-arms it after {breaker.cooldown_s}s).",
                     RuntimeWarning,
                     stacklevel=2,
                 )
             return None
         wall = time.perf_counter() - t0
-        self._lanestack_failures = 0
+        if breaker.record_success():
+            self.breakers.record_restoration("lanestack")
+        # The stacked path serves these requests INSTEAD of the per-graph
+        # loop, so it must also report the cell breaker's outcome — a
+        # half-open cell probe served stacked would otherwise never close
+        # the breaker and pin a healthy cell at one-probe-per-cooldown.
+        cbr = self.breakers.get("cell", cell_key)
+        if cbr.record_success():
+            self.breakers.record_restoration("cell")
         # Key warm accounting on what this batch ACTUALLY dispatched: the
         # runner's recorded layout key (level-0 stack buckets + per-level
         # layout signatures x lane counts) with (k, epsilon) — the request
@@ -954,7 +1226,55 @@ class PartitionEngine:
             req.service_s = wall
         return list(live)
 
+    def _request_solver(self, req: ServeRequest):
+        """The solver serving this request, after the quality ladder rung:
+        explicit ``quality="fast"`` requests take the trimmed solver; a
+        "strong" request is demoted to it when the cell's quality breaker
+        is open (capacity-class failures tripped it) — counted, warned
+        once, and restored by the half-open probe."""
+        if req.quality == "fast":
+            return self._get_fast_solver(), False
+        key = (req.cell.n_bucket, req.cell.m_bucket, req.cell.k)
+        qbreaker = self.breakers.get("quality_strong", key)
+        if not qbreaker.allow():
+            self.stats_.bump("demoted_quality")
+            self.breakers.record_demotion(
+                "quality_strong", "capacity pressure in this cell"
+            )
+            return self._get_fast_solver(), False
+        return self._solver, True
+
+    def _get_fast_solver(self):
+        """Lazily-built trimmed-refinement solver: the balancer+LP chain
+        with halved LP sweeps and single-rep extension — the same
+        deterministic pipeline shape, a lighter quality tier."""
+        if self._fast_solver is None:
+            from ..context import RefinementAlgorithm
+            from ..kaminpar import KaMinPar
+
+            fast = copy.deepcopy(self.ctx)
+            keep = (
+                RefinementAlgorithm.OVERLOAD_BALANCER,
+                RefinementAlgorithm.LP,
+                RefinementAlgorithm.UNDERLOAD_BALANCER,
+                RefinementAlgorithm.GREEDY_BALANCER,
+            )
+            fast.refinement.algorithms = tuple(
+                a for a in fast.refinement.algorithms if a in keep
+            ) or (RefinementAlgorithm.OVERLOAD_BALANCER,
+                  RefinementAlgorithm.LP)
+            fast.refinement.lp.num_iterations = max(
+                1, fast.refinement.lp.num_iterations // 2
+            )
+            fast.initial_partitioning.nested_extension_reps = 1
+            fast.initial_partitioning.device_extension_reps = 1
+            self._fast_solver = KaMinPar(fast)
+        return self._fast_solver
+
     def _execute_live(self, live: List[ServeRequest]) -> None:
+        from ..resilience.errors import classify
+        from ..resilience.faults import maybe_inject
+
         ok = self._try_lanestacked(live)
         stacked = ok is not None
         if ok is None:
@@ -966,27 +1286,71 @@ class PartitionEngine:
                 # wall.
                 req.queue_wait_s = time.monotonic() - req.enqueue_t
                 t0 = time.perf_counter()
+                key = (req.cell.n_bucket, req.cell.m_bucket, req.cell.k)
+                # Provisional tier for the except path (a fault can fire
+                # before _request_solver resolves the actual tier).
+                strong = req.quality == "strong"
                 try:
+                    maybe_inject("execute", site="engine_request")
+                    solver, strong = self._request_solver(req)
+                    req.quality_served = "strong" if strong else "fast"
                     # The warm facade runs the *identical* code path a cold
                     # sequential KaMinPar.compute_partition runs (including
                     # its per-call RNG reseed), so per-graph results are
                     # bit-identical to single-graph runs by construction.
-                    self._solver.set_graph(req.graph)
-                    req.partition = self._solver.compute_partition(
+                    solver.set_graph(req.graph)
+                    req.partition = solver.compute_partition(
                         req.k, req.epsilon, req.max_block_weights,
                         req.min_epsilon, req.min_block_weights,
                     )
                     req.caps = np.asarray(
-                        self._solver.ctx.partition.max_block_weights,
+                        solver.ctx.partition.max_block_weights,
                         dtype=np.int64,
                     ).copy()
                     req.execute_s = time.perf_counter() - t0
                     ok.append(req)
+                    if not req.future.done():
+                        # A done future means the watchdog already rejected
+                        # this request as hung and TRIPPED the breaker —
+                        # the late-returning dispatch must not record a
+                        # success that would silently close it (the next
+                        # request would re-enter the same hang).
+                        cbr = self.breakers.get("cell", key)
+                        if cbr.record_success():
+                            self.breakers.record_restoration("cell")
+                        if strong:
+                            qbr = self.breakers.get("quality_strong", key)
+                            if qbr.record_success():
+                                self.breakers.record_restoration(
+                                    "quality_strong"
+                                )
                 except Exception as exc:  # noqa: BLE001 — per-request isolation
-                    self.stats_.record_request(
-                        req.queue_wait_s, time.perf_counter() - t0, failed=True
-                    )
-                    req.future._reject(exc)
+                    # Route through the ONE classifier (round 17): callers
+                    # get a typed failure, and the failure class picks the
+                    # breaker — capacity pressure trips the quality rung
+                    # (later strong requests demote to fast), everything
+                    # else trips the cell breaker (enough repeats poison
+                    # the cell at admission).  A False reject means the
+                    # watchdog already force-resolved this future AND
+                    # recorded the failure + breaker trip — don't
+                    # double-count the late arrival.
+                    err = classify(exc, site="engine_request")
+                    if req.future._reject(err):
+                        if err.failure_class == "capacity-exceeded" and strong:
+                            self.breakers.get(
+                                "quality_strong", key
+                            ).record_failure()
+                        else:
+                            # Fast-tier capacity failures land here too:
+                            # a cell that OOMs even under the trimmed
+                            # solver has no further rung to demote to —
+                            # it must poison at admission, not burn a
+                            # doomed dispatch per request.
+                            self.breakers.get("cell", key).record_failure()
+                        self.stats_.record_request(
+                            req.queue_wait_s, time.perf_counter() - t0,
+                            failed=True,
+                        )
         if not ok:
             return
 
@@ -1011,12 +1375,11 @@ class PartitionEngine:
                 # it here would report a later lone request in this cell
                 # as a warm hit while it pays the full per-graph compile
                 # (the stacked path tracks its own _warm_stack_keys).
-                self._note_warm(req.cell)
+                self._note_warm(
+                    req.cell, req.quality_served or req.quality
+                )
             feasible = bool(np.all(bws[i] <= req.caps))
-            self.stats_.record_request(
-                req.queue_wait_s, req.execute_s, service_s=req.service_s
-            )
-            req.future._resolve(ServeResult(
+            resolved = req.future._resolve(ServeResult(
                 partition=req.partition,
                 cut=int(cuts[i]),
                 feasible=feasible,
@@ -1026,6 +1389,14 @@ class PartitionEngine:
                 warm_hit=req.warm_hit,
                 request_id=req.id,
             ))
+            if not resolved:
+                # The watchdog already force-resolved this future (the
+                # dispatch was abandoned as hung and came back late): the
+                # failure was recorded there — don't double-count.
+                continue
+            self.stats_.record_request(
+                req.queue_wait_s, req.execute_s, service_s=req.service_s
+            )
             if rec is not None:
                 rec.instant(
                     "serve.resolve", request_id=req.id, cut=int(cuts[i]),
@@ -1044,6 +1415,20 @@ class PartitionEngine:
         snap["running"] = self._running
         snap["warm_cells"] = len(self._warm_cells)
         snap["warmup"] = list(self.warmup_report)
+        # Resilience surface (round 17): this engine's breaker registry
+        # (lanestack/cell/quality rungs), the process-global pipeline
+        # registry (lp_pallas/ip_device/device_decode rungs), the
+        # watchdog's guard/fire census + dossier heads, and the chaos
+        # harness's injection counters.
+        from ..resilience import breakers as rbreakers
+        from ..resilience import faults as rfaults
+
+        snap["resilience"] = {
+            "engine": self.breakers.snapshot(),
+            "pipeline": rbreakers.global_registry().snapshot(),
+            "watchdog": self.watchdog.snapshot(),
+            "faults": rfaults.snapshot(),
+        }
         return snap
 
     def metrics_text(self) -> str:
@@ -1065,4 +1450,18 @@ class PartitionEngine:
         # temp bytes from XLA's own analyses, exported beside the serve
         # metrics so operators scrape what each executable WOULD do.
         families.extend(compile_stats.census_prometheus_families())
+        # Resilience families (round 17): breaker states/trips, ladder
+        # demotions + restorations, chaos injections — merged over this
+        # engine's registry and the process-global pipeline registry.
+        from ..resilience import breakers as rbreakers
+
+        families.extend(rbreakers.prometheus_families(
+            self.breakers, rbreakers.global_registry()
+        ))
+        families.append((
+            "kaminpar_resilience_watchdog_fired_total", "counter",
+            "Execution-watchdog deadline overruns converted into breaker "
+            "trips + typed future resolutions",
+            [({}, self.watchdog.fired)],
+        ))
         return prometheus.render(families)

@@ -1017,6 +1017,13 @@ class LaneStackRunner:
 def run_lanestacked(ctx: Context, graphs: Sequence, k: int, epsilon: float):
     """Execute a same-cell batch lane-stacked; returns (partitions, report).
     Raises :class:`LaneStackUnsupported` for out-of-envelope batches."""
+    from ..resilience.faults import maybe_inject
+
+    # Named "execute" injection point of the stacked path (round 17): the
+    # engine's lanestack breaker + per-graph fallback are exercised by
+    # chaos plans targeting site "lanestack".  Before any lane prep, so a
+    # faulted batch leaves no partial per-lane state behind.
+    maybe_inject("execute", site="lanestack")
     runner = LaneStackRunner(ctx, graphs, k, epsilon)
     parts = runner.run()
     return parts, runner.report

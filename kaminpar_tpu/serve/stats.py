@@ -62,6 +62,13 @@ class ServeStats:
         # per-graph loop.
         "lanestacked_batches", "lanestacked_lanes", "lanestack_splits",
         "lanestack_fallbacks",
+        # Resilience census (round 17, kaminpar_tpu/resilience): fast
+        # admission rejects from a poisoned (open-breaker) shape cell,
+        # in-flight requests force-resolved by the bounded drain after the
+        # worker died/hung, watchdog deadline overruns, strong->fast
+        # quality demotions, and contained warmup-pass faults.
+        "rejected_poisoned", "worker_hung", "watchdog_timeouts",
+        "demoted_quality", "warmup_faults",
     )
 
     def __init__(self):
@@ -203,7 +210,8 @@ class ServeStats:
         snap = self.snapshot(queue_depth=queue_depth)
         outcome_counters = (
             "submitted", "admitted", "rejected_full", "rejected_capacity",
-            "timed_out", "cancelled", "completed", "failed",
+            "rejected_poisoned", "timed_out", "cancelled", "completed",
+            "failed", "worker_hung",
         )
         lat_samples = []
         count_samples = []
@@ -251,6 +259,14 @@ class ServeStats:
             ("kaminpar_serve_lanestack_occupancy", "gauge",
              "Mean lanes per lane-stacked batch",
              [({}, snap["lanestack_occupancy_mean"])]),
+            ("kaminpar_serve_resilience_events_total", "counter",
+             "Resilience-layer events: watchdog deadline overruns, "
+             "strong->fast quality demotions, contained warmup faults "
+             "(round 17; breaker detail rides the "
+             "kaminpar_resilience_* families)",
+             [({"event": "watchdog_timeout"}, snap["watchdog_timeouts"]),
+              ({"event": "demoted_quality"}, snap["demoted_quality"]),
+              ({"event": "warmup_fault"}, snap["warmup_faults"])]),
             ("kaminpar_serve_latency_ms", "gauge",
              "Latency percentiles in milliseconds over the rolling reservoir",
              lat_samples),

@@ -548,6 +548,151 @@ def doctor(argv) -> int:
     return 0
 
 
+def chaos(argv) -> int:
+    """Injected-fault soak (ISSUE 13): run a short serve burst under an
+    armed fault plan and report recovery — per-request outcomes,
+    time-to-recover (first success after the first fault), breaker
+    trips, and degradation-ladder demotion counts — then append the
+    metrics to RUNS.jsonl under the regress sentinel (kind="chaos"), so
+    a recovery regression fails the gate like a perf regression.  Plans
+    are seed-keyed (resilience/faults.py), so a soak replays
+    bit-for-bit under the same --plan/--seed."""
+    import json as _json
+    import time as _time
+
+    p = argparse.ArgumentParser(prog="chaos")
+    p.add_argument("--plan", default="execute@engine_request:execute-fault:n=2",
+                   help="fault plan (resilience/faults.py syntax; default "
+                        "fails the first 2 engine executes)")
+    p.add_argument("--seed", type=int, default=0, help="fault-plan seed")
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--scale", type=int, default=7,
+                   help="RMAT scale of the soak graphs")
+    p.add_argument("-k", type=int, default=4)
+    p.add_argument("-P", "--preset", default="serve")
+    p.add_argument("--cooldown", type=float, default=1.0,
+                   help="breaker cooldown for the soak engine (short, so "
+                        "the half-open recovery is observed in-run)")
+    p.add_argument("--runs", default=None, metavar="PATH",
+                   help="ledger path (default RUNS.jsonl)")
+    p.add_argument("--no-ledger", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    from ..graph.generators import rmat_graph
+    from ..presets import create_context_by_preset_name
+    from ..resilience import breakers as rbreakers
+    from ..resilience import faults as rfaults
+    from ..resilience.errors import ResilienceError
+    from ..serve.engine import PartitionEngine
+    from ..telemetry import ledger as led
+
+    rfaults.reset()
+    rbreakers.reset_global_registry()
+    ctx = create_context_by_preset_name(args.preset)
+    ctx.resilience.fault_plan = args.plan
+    ctx.resilience.fault_seed = args.seed
+    ctx.resilience.breaker_cooldown_s = args.cooldown
+    engine = PartitionEngine(
+        ctx, warm_ladder=(), warm_ks=(),
+        queue_bound=max(16, args.requests), max_batch=4,
+    )
+    engine.start(warmup=False)
+    outcomes = []
+    t_first_fault = t_recovered = None
+    t0 = _time.monotonic()
+    try:
+        for i in range(args.requests):
+            g = rmat_graph(args.scale, edge_factor=4, seed=100 + i)
+            t_req = _time.monotonic()
+            try:
+                engine.partition(g, args.k)
+                outcomes.append("ok")
+                if t_first_fault is not None and t_recovered is None:
+                    t_recovered = _time.monotonic()
+            except ResilienceError as exc:
+                outcomes.append(exc.failure_class)
+                if t_first_fault is None:
+                    t_first_fault = t_req
+            except Exception as exc:  # noqa: BLE001 — soak verdicts must
+                # name unexpected (unclassified) escapes, not crash on them
+                outcomes.append(f"UNCLASSIFIED:{type(exc).__name__}")
+                if t_first_fault is None:
+                    t_first_fault = t_req
+    finally:
+        engine.shutdown(drain=True)
+    wall = _time.monotonic() - t0
+
+    snap = engine.stats()["resilience"]
+    demotions: dict = {}
+    for reg in (snap["engine"], snap["pipeline"]):
+        for path, count in reg["demotions"].items():
+            demotions[path] = demotions.get(path, 0) + count
+    trips = sum(
+        br["trips"]
+        for reg in (snap["engine"], snap["pipeline"])
+        for br in reg["breakers"].values()
+    )
+    injected = snap["faults"]["points"]
+    recovered = bool(outcomes) and outcomes[-1] == "ok" and not any(
+        o.startswith("UNCLASSIFIED") for o in outcomes
+    )
+    recover_s = (
+        round(t_recovered - t_first_fault, 3)
+        if (t_first_fault is not None and t_recovered is not None)
+        else (0.0 if t_first_fault is None else None)
+    )
+    record = {
+        "backend": _backend_name(),
+        "chaos_plan": args.plan,
+        "chaos_seed": args.seed,
+        "chaos_requests": len(outcomes),
+        "chaos_ok": sum(1 for o in outcomes if o == "ok"),
+        "chaos_faulted": sum(1 for o in outcomes if o != "ok"),
+        "chaos_injected_count": sum(r["injected"] for r in injected.values()),
+        "chaos_demotion_count": sum(demotions.values()),
+        "chaos_breaker_trips": trips,
+        # int, not bool: the ledger's metric extraction keeps numerics only
+        "chaos_recovered": int(recovered),
+        "chaos_wall_s": round(wall, 3),
+    }
+    if recover_s is not None:
+        record["chaos_recover_s"] = recover_s
+    summary = {
+        **record,
+        "outcomes": outcomes,
+        "demotions": demotions,
+        "injected_by_point": injected,
+        "watchdog": snap["watchdog"],
+    }
+    if not args.no_ledger:
+        led.append(led.build_entry(record, kind="chaos"),
+                   args.runs or led.default_path())
+    if args.as_json:
+        print(_json.dumps(summary))
+    else:
+        print(f"chaos soak: plan={args.plan!r} seed={args.seed} "
+              f"({len(outcomes)} requests on {record['backend']})")
+        print(f"  outcomes: {' '.join(outcomes)}")
+        print(f"  injected: {record['chaos_injected_count']} "
+              f"(by point: {injected})")
+        print(f"  demotions: {demotions or '(none)'}  breaker trips: {trips}")
+        print(f"  time-to-recover: {recover_s}s  wall: {record['chaos_wall_s']}s")
+        print(f"  recovered: {recovered}")
+        if not args.no_ledger:
+            print(f"  ledger: appended kind=chaos entry")
+    return 0 if recovered else 1
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — a dead backend is a valid soak env
+        return "unknown"
+
+
 def lint(argv) -> int:
     """kptlint (ISSUE 7): AST-level enforcement of the device-discipline
     contracts — sync budget, runtime isolation, phase registry, RNG and
@@ -561,6 +706,7 @@ def lint(argv) -> int:
 
 REGISTRY = {
     "capacity": capacity,
+    "chaos": chaos,
     "doctor": doctor,
     "graph-properties": graph_properties,
     "ledger": ledger,

@@ -178,6 +178,12 @@ def pull(*arrays, phase: str | None = None, lanes: int = 0, shards: int = 0):
     """
     import jax
 
+    from ..resilience.faults import maybe_inject
+
+    # Named "readback" injection point (round 17): every counted blocking
+    # transfer is a place the device can fail to answer — the chaos
+    # harness arms readback-class faults here (disarmed: one flag read).
+    maybe_inject("readback", site=phase or _phase())
     out = []
     # The explicit allow makes pull() the sanctioned escape hatch inside
     # guard(): strays raise, batched readbacks pass.

@@ -630,6 +630,120 @@ def test_lint_cli_list_rules():
         assert rule in out.stdout
 
 
+# ---------------------------------------------------------------------------
+# error-discipline (round 17, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_error_rule_fires_on_bare_runtimeerror():
+    findings = analyze(
+        """
+        def f(x):
+            raise RuntimeError("device pool failed")
+        """,
+        rel="kaminpar_tpu/serve/_snippet.py",
+    )
+    assert "error-discipline" in rules_of(findings)
+
+
+def test_error_rule_fires_on_unclassified_dispatch_handler():
+    """The pre-round-17 engine pattern: a broad except around a dispatch
+    site wrapping the failure in an untyped ServeError."""
+    findings = analyze(
+        """
+        class ServeError(RuntimeError):
+            pass
+
+        def f(solver, reqs):
+            try:
+                solver.compute_partition(4, 0.03)
+            except Exception as exc:
+                for r in reqs:
+                    r.future._reject(ServeError(f"batch failed: {exc!r}"))
+        """,
+        rel="kaminpar_tpu/serve/_snippet.py",
+    )
+    assert "error-discipline" in rules_of(findings)
+
+
+def test_error_rule_fires_on_laundered_valueerror():
+    findings = analyze(
+        """
+        def f(g):
+            try:
+                return g.dispatch()
+            except Exception as exc:
+                raise ValueError(str(exc))
+        """,
+        rel="kaminpar_tpu/ops/_snippet.py",
+    )
+    assert "error-discipline" in rules_of(findings)
+
+
+def test_error_rule_clean_on_classify_and_validation():
+    """classify-routed handlers, typed raises, bare re-raises, narrow
+    handlers, and plain argument validation all pass."""
+    findings = analyze(
+        """
+        from ..resilience.errors import ExecuteFault, classify
+
+        def f(solver, k):
+            if k <= 0:
+                raise ValueError("k must be positive")
+            try:
+                return solver.compute_partition(k, 0.03)
+            except KeyError:
+                return None
+            except Exception as exc:
+                raise classify(exc, site="test")
+
+        def g(solver):
+            try:
+                return solver.compute_partition(2, 0.03)
+            except Exception:
+                raise ExecuteFault("typed", site="test")
+
+        def h(solver):
+            try:
+                return solver.compute_partition(2, 0.03)
+            except Exception:
+                raise
+        """,
+        rel="kaminpar_tpu/serve/_snippet.py",
+    )
+    assert "error-discipline" not in rules_of(findings)
+
+
+def test_error_rule_mutation_gate_engine_loop():
+    """Deleting the classify routing from the real engine dispatcher
+    handler trips error-discipline on the real source."""
+    engine_src = (REPO / "kaminpar_tpu" / "serve" / "engine.py").read_text()
+    rel = "kaminpar_tpu/serve/engine.py"
+    analyzer = Analyzer(ALL_RULES, default_config())
+    clean = [
+        f for f in analyzer.check_source(
+            engine_src, rel=rel, modname="kaminpar_tpu.serve.engine"
+        )
+        if not f.suppressed and f.rule == "error-discipline"
+    ]
+    assert clean == []
+    assert "err = classify(exc, site=\"dispatch\")" in engine_src
+    mutated = engine_src.replace(
+        "err = classify(exc, site=\"dispatch\")",
+        "err = ServeError(f\"batch failed: {exc!r}\")",
+    ).replace(
+        "from ..resilience.errors import classify\n\n                err",
+        "err",
+    )
+    fired = [
+        f for f in analyzer.check_source(
+            mutated, rel=rel, modname="kaminpar_tpu.serve.engine"
+        )
+        if not f.suppressed and f.rule == "error-discipline"
+    ]
+    assert fired, "mutated dispatcher handler must trip error-discipline"
+
+
 def test_every_shipped_rule_has_fire_and_suppress_coverage():
     """Meta-gate: each shipped rule fires on at least one fixture above AND
     honors suppression (spot-checked here for the remaining rules)."""
@@ -650,6 +764,13 @@ def test_every_shipped_rule_has_fire_and_suppress_coverage():
             "@partial(jax.jit, donate_argnums=(0,))\n"
             "def step(s):\n    return s\n"
             "def f(s):\n    out = step(s)\n    return out, s\n"
+        ),
+        "error-discipline": (
+            "def f(solver):\n"
+            "    try:\n"
+            "        return solver.compute_partition(2, 0.03)\n"
+            "    except Exception as exc:\n"
+            "        raise RuntimeError(str(exc))\n"
         ),
     }
     analyzer = Analyzer(ALL_RULES, default_config())
