@@ -950,6 +950,249 @@ def run_shard_phase(record: dict | None = None) -> dict:
     return record
 
 
+def run_fleet_phase(record: dict | None = None) -> dict:
+    """Phase 6 (ISSUE 14): single-engine vs N-replica fleet A/B on the
+    P-device mesh (CPU dryrun: forced virtual host devices, like shard_ab).
+
+    One same-cell burst workload served two ways: (a) ONE warm
+    PartitionEngine — the PR 3/6 pattern, lane axis only — and (b) a
+    :class:`~kaminpar_tpu.serve.fleet.PartitionFleet` of P per-device
+    replicas behind the SLO-aware shape-cell router (lane x device).  Per
+    arm: aggregate graphs/s, per-replica batch occupancy, p50/p99 total
+    latency (computed fleet-wide from the request results themselves),
+    steer/resteer counts, warm-cache inheritance counts (replica 0 pays
+    the ladder, replicas 1..N-1 import it — the inherit ratio is a ledger
+    metric), and a per-replica bit-identity probe against a sequential
+    facade run.  Flat ``fleet_*`` keys ride RUNS.jsonl under the ``tools
+    regress`` sentinel; tpu_prober carries the phase on-silicon.
+
+    CPU-dryrun honesty (TPU_NOTES round 18): virtual host devices
+    SERIALIZE — the aggregate-throughput ratio is a *device* claim; on CPU
+    this phase proves routing, occupancy, inheritance, and bit-identity,
+    not speedup.
+    """
+    import jax
+    import numpy as np
+
+    from kaminpar_tpu.graph.generators import rmat_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.serve import PartitionEngine, PartitionFleet, QueueFullError
+    from kaminpar_tpu.serve.batching import shape_cell
+    from kaminpar_tpu.utils import RandomState
+
+    record = dict(record or {})
+    P = int(os.environ.get("KPTPU_BENCH_FLEET_P", 8))
+    scale = int(os.environ.get("KPTPU_BENCH_FLEET_SCALE", 8))
+    k = int(os.environ.get("KPTPU_BENCH_FLEET_K", 8))
+    n_req = int(os.environ.get("KPTPU_BENCH_FLEET_REQS", 64))
+    max_batch = int(os.environ.get("KPTPU_BENCH_FLEET_MAX_BATCH", 8))
+    devs = jax.devices()
+    backend = devs[0].platform
+    if len(devs) < P:
+        raise RuntimeError(
+            f"fleet phase needs {P} devices, have {len(devs)} (the --child "
+            "entry forces virtual CPU devices; in-process callers must)"
+        )
+
+    # Same-cell burst workload: distinct seeds from one RMAT family,
+    # filtered to the dominant shape cell (the batch population the serve
+    # queue and the router actually see).
+    pool = [rmat_graph(scale, edge_factor=8, seed=300 + i)
+            for i in range(2 * n_req)]
+    cells = [shape_cell(g, k) for g in pool]
+    head = max(set(cells), key=cells.count)
+    graphs = [g for g, c in zip(pool, cells) if c == head][:n_req]
+    n_req = len(graphs)
+
+    serve_cfg = dict(
+        warm_ladder=(1 << scale,), warm_ks=(k,), max_batch=max_batch,
+        queue_bound=max(n_req, 8),
+    )
+
+    def _submit_backpressured(target, g):
+        while True:
+            try:
+                return target.submit(g, k)
+            except QueueFullError as e:
+                time.sleep(e.retry_after_s)
+
+    def _measure_burst(target) -> dict:
+        # Burst with a held dispatcher so the queues (and the router's
+        # batch-join fill) see the whole offered load, then release.
+        target.pause()
+        t0 = time.perf_counter()
+        futures = [_submit_backpressured(target, g) for g in graphs]
+        target.resume()
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - t0
+        totals = [
+            (r.queue_wait_s + r.execute_s) * 1e3 for r in results
+        ]
+        return {
+            "wall_s": round(wall, 2),
+            "throughput_gps": round(n_req / wall, 2),
+            "p50_ms": round(float(np.percentile(totals, 50)), 1),
+            "p99_ms": round(float(np.percentile(totals, 99)), 1),
+            "results": results,
+        }
+
+    ab: dict = {"backend": backend, "replicas": P, "scale": scale, "k": k,
+                "requests": n_req, "max_batch": max_batch}
+
+    # Sequential reference for the bit-identity probe (the engine contract:
+    # warm serve results == cold facade runs).
+    RandomState.reseed(0)
+    ref_solver = KaMinPar(ctx="serve")
+    ref_solver.set_graph(graphs[0])
+    ref_part = ref_solver.compute_partition(k, 0.03)
+
+    # Arm A: one warm engine (lane axis only).
+    RandomState.reseed(0)
+    engine = PartitionEngine("serve", **serve_cfg)
+    t0 = time.perf_counter()
+    engine.start(warmup=True)
+    single_warm_s = time.perf_counter() - t0
+    try:
+        for fut in [_submit_backpressured(engine, g) for g in graphs]:
+            fut.result()  # preflight: pay first-touch traces unmeasured
+        engine.stats_.reset()
+        burst = _measure_burst(engine)
+        snap = engine.stats_.snapshot()
+        ab["single"] = {
+            "warmup_s": round(single_warm_s, 2),
+            "wall_s": burst["wall_s"],
+            "throughput_gps": burst["throughput_gps"],
+            "p50_ms": burst["p50_ms"],
+            "p99_ms": burst["p99_ms"],
+            "batch_occupancy_mean": snap["batch_occupancy_mean"],
+            "batch_occupancy_max": snap["batch_occupancy_max"],
+            "lanestacked_batches": snap["lanestacked_batches"],
+        }
+    finally:
+        engine.shutdown(drain=True)
+
+    # Arm B: the P-replica fleet (lane x device).
+    RandomState.reseed(0)
+    fleet = PartitionFleet("serve", replicas=P, **serve_cfg)
+    t0 = time.perf_counter()
+    fleet.start(warmup=True)
+    fleet_warm_s = time.perf_counter() - t0
+    try:
+        inherit = [r.warmup_cell_counts() for r in fleet.replicas]
+        inherited_total = sum(c["inherited"] for c in inherit[1:])
+        report_total = sum(
+            c["inherited"] + c["local"] for c in inherit[1:]
+        )
+        # Per-replica bit-identity probe: the same (graph, seed, k) request
+        # pinned to the first and last replica must equal the sequential
+        # facade run exactly (the acceptance witness).
+        probes = [
+            fleet.submit(graphs[0], k, replica=r).result().partition
+            for r in (0, P - 1)
+        ]
+        ab["identical_partition"] = bool(all(
+            np.array_equal(p, ref_part) for p in probes
+        ))
+        # Preflight (unmeasured): pay first-touch traces on every replica,
+        # then zero the measured window.
+        for fut in [_submit_backpressured(fleet, g) for g in graphs]:
+            fut.result()
+        for r in fleet.replicas:
+            r.stats_.reset()
+        # Router counters are cumulative (probes + preflight + every
+        # backpressure retry re-entering submit): snapshot here so the
+        # ledger reports the measured burst's DELTA, not process totals.
+        pre = fleet.stats()
+        burst = _measure_burst(fleet)
+        per_replica = []
+        agg_occupancy = 0.0
+        for i, r in enumerate(fleet.replicas):
+            snap = r.stats_.snapshot()
+            agg_occupancy += snap["batch_occupancy_max"]
+            per_replica.append({
+                "replica": i,
+                "completed": snap["completed"],
+                "batch_occupancy_mean": snap["batch_occupancy_mean"],
+                "batch_occupancy_max": snap["batch_occupancy_max"],
+                "lanestacked_batches": snap["lanestacked_batches"],
+                "lanestacked_lanes": snap["lanestacked_lanes"],
+                "inherited_cells": inherit[i]["inherited"],
+                "local_cells": inherit[i]["local"],
+            })
+        fstats = fleet.stats()
+        ab["fleet"] = {
+            "warmup_s": round(fleet_warm_s, 2),
+            "wall_s": burst["wall_s"],
+            "throughput_gps": burst["throughput_gps"],
+            "p50_ms": burst["p50_ms"],
+            "p99_ms": burst["p99_ms"],
+            "aggregate_occupancy": agg_occupancy,
+            "steered": (
+                sum(r["steered"] for r in fstats["per_replica"])
+                - sum(r["steered"] for r in pre["per_replica"])
+            ),
+            "resteers": fstats["resteers"] - pre["resteers"],
+            "sticky_hits": fstats["sticky_hits"] - pre["sticky_hits"],
+            "rejected_full": fstats["rejected_full"] - pre["rejected_full"],
+            "inherited_cells": inherited_total,
+            "per_replica": per_replica,
+        }
+    finally:
+        fleet.shutdown(drain=True)
+
+    record["fleet_ab"] = ab
+    # Standalone child runs feed the ledger directly (tools ledger
+    # append): tag the backend so baseline windows stay comparable.
+    record.setdefault("backend", backend)
+    # Flat ledger keys under the regress sentinel (telemetry/ledger
+    # direction markers: _gps/_vs_/_ratio up, _ms/_s/count down).
+    record.update({
+        "fleet_single_gps": ab["single"]["throughput_gps"],
+        "fleet_agg_gps": ab["fleet"]["throughput_gps"],
+        "fleet_vs_single": round(
+            ab["fleet"]["throughput_gps"]
+            / max(ab["single"]["throughput_gps"], 1e-9), 2
+        ),
+        "fleet_p50_ms": ab["fleet"]["p50_ms"],
+        "fleet_p99_ms": ab["fleet"]["p99_ms"],
+        "fleet_aggregate_occupancy": ab["fleet"]["aggregate_occupancy"],
+        "fleet_resteer_count": ab["fleet"]["resteers"],
+        "fleet_identical": int(ab["identical_partition"]),
+        "fleet_inherit_ratio": round(
+            inherited_total / max(report_total, 1), 3
+        ),
+        "fleet_warmup_s": ab["fleet"]["warmup_s"],
+    })
+    print(json.dumps(record, default=str), flush=True)
+    return record
+
+
+def _merge_child_phase(rec: dict, phase: str, sentinel: str, prefix: str,
+                       *, echo: bool = False) -> None:
+    """Run one bench phase in its own child process and merge its
+    ``prefix``-keyed results into ``rec`` — shard_ab and fleet_ab both
+    need their own device topology (P virtual CPU devices for the dryrun,
+    KPTPU_BENCH_*_NATIVE=1 keeps a real mesh), so they never run in this
+    process.  ``sentinel`` gates success and names the error key; the
+    timeout rides KPTPU_BENCH_<PHASE>_TIMEOUT."""
+    timeout = float(
+        os.environ.get(f"KPTPU_BENCH_{phase.upper()}_TIMEOUT", 900)
+    )
+    child_rec, child_err = _run_child(timeout, extra_env={
+        "KPTPU_BENCH_PHASE": phase,
+    })
+    if child_rec and sentinel in child_rec:
+        for key, val in child_rec.items():
+            if key.startswith(prefix):
+                rec[key] = val
+        if echo:
+            print(json.dumps(rec), flush=True)
+    else:
+        rec[f"{sentinel}_error"] = (
+            child_err or f"{phase} phase produced no record"
+        )
+
+
 def run_benchmark() -> dict:
     """All phases in-process (used by the prober child and --child mode).
     Returns the final headline record (the ledger entry's source)."""
@@ -964,20 +1207,11 @@ def run_benchmark() -> dict:
         except Exception as exc:  # noqa: BLE001 — A/B must not void phases 1-3
             record["compress_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     if os.environ.get("KPTPU_BENCH_SHARD", "1") == "1":
-        # Phase 5 needs its own device topology (P virtual CPU devices for
-        # the dryrun — the backend here is already initialized, possibly
-        # with one device), so it always runs in a child process.
-        shard_timeout = float(os.environ.get("KPTPU_BENCH_SHARD_TIMEOUT", 900))
-        shard_rec, shard_err = _run_child(shard_timeout, extra_env={
-            "KPTPU_BENCH_PHASE": "shard",
-        })
-        if shard_rec and "shard_ab" in shard_rec:
-            for key, val in shard_rec.items():
-                if key.startswith("shard_ab"):
-                    record[key] = val
-            print(json.dumps(record), flush=True)
-        else:
-            record["shard_ab_error"] = shard_err or "shard phase produced no record"
+        _merge_child_phase(record, "shard", "shard_ab", "shard_ab",
+                           echo=True)
+    if os.environ.get("KPTPU_BENCH_FLEET", "1") == "1":
+        _merge_child_phase(record, "fleet", "fleet_ab", "fleet_",
+                           echo=True)
     return record
 
 
@@ -1199,19 +1433,13 @@ def _cpu_fallback(err: str, telemetry: dict | None) -> None:
                     rec[key] = val
         else:
             rec["serve_error"] = serve_err or "serve phase produced no record"
-    # Phase 5 (shard_ab, ISSUE 11) in its own child: it forces its own
-    # virtual 8-device CPU mesh regardless of this process's 1-device pin.
+    # Phases 5/6 (shard_ab / fleet_ab) in their own children: each forces
+    # its own virtual P-device CPU mesh regardless of this process's
+    # 1-device pin.
     if os.environ.get("KPTPU_BENCH_SHARD", "1") == "1":
-        shard_timeout = float(os.environ.get("KPTPU_BENCH_SHARD_TIMEOUT", 900))
-        shard_rec, shard_err = _run_child(shard_timeout, extra_env={
-            "KPTPU_BENCH_PHASE": "shard",
-        })
-        if shard_rec and "shard_ab" in shard_rec:
-            for key, val in shard_rec.items():
-                if key.startswith("shard_ab"):
-                    rec[key] = val
-        else:
-            rec["shard_ab_error"] = shard_err or "shard phase produced no record"
+        _merge_child_phase(rec, "shard", "shard_ab", "shard_ab")
+    if os.environ.get("KPTPU_BENCH_FLEET", "1") == "1":
+        _merge_child_phase(rec, "fleet", "fleet_ab", "fleet_")
     rec.setdefault("git_head", _git_head())
     rec.setdefault("stale_vs_head", False)  # fallback measured at head
     print(json.dumps(rec))
@@ -1238,6 +1466,15 @@ def main() -> None:
 
                 force_cpu_devices(int(os.environ.get("KPTPU_BENCH_SHARD_P", 8)))
             run_shard_phase()
+            return
+        if phase == "fleet":
+            # The P-replica fleet dryrun (ISSUE 14): same virtual-mesh
+            # forcing contract as the shard phase.
+            if os.environ.get("KPTPU_BENCH_FLEET_NATIVE") != "1":
+                from kaminpar_tpu.utils.platform import force_cpu_devices
+
+                force_cpu_devices(int(os.environ.get("KPTPU_BENCH_FLEET_P", 8)))
+            run_fleet_phase()
             return
         if os.environ.get("KPTPU_CHILD_FORCE_CPU") == "1":
             from kaminpar_tpu.utils.platform import force_cpu_devices

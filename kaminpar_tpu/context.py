@@ -442,6 +442,16 @@ class ParallelContext:
     # on their sentinel so phase wall-clock measures compute, not dispatch.
     # Off by default — it serializes the async pipeline it measures.
     sync_timers: bool = False
+    # Fleet placement (round 18, serve/fleet.py): index into jax.devices()
+    # this engine's dispatches default to.  None = jax's own default (device
+    # 0).  The EngineRuntime activation wraps jax.default_device around the
+    # owning engine's pipeline runs, so N single-device engine replicas in
+    # one process land on N distinct mesh devices (arrays stay uncommitted —
+    # placement steers dispatch, it never forbids a transfer).  On the CPU
+    # backend the "devices" are the forced virtual host devices (the same
+    # dryrun substrate the shard_ab bench uses), which SERIALIZE — see
+    # TPU_NOTES round 18 for what a CPU fleet number does and does not claim.
+    placement_device: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -571,6 +581,9 @@ class EngineRuntime:
     cache_dir: Optional[str] = None
     layout_build: str = "auto"
     sync_timers: bool = False
+    # Fleet placement (round 18): jax.devices() index the activation pins as
+    # jax.default_device for this thread; None = backend default.
+    device_index: Optional[int] = None
 
     @classmethod
     def from_parallel(cls, parallel: "ParallelContext") -> "EngineRuntime":
@@ -580,6 +593,7 @@ class EngineRuntime:
             cache_dir=cache_dir,
             layout_build=parallel.device_layout_build,
             sync_timers=bool(parallel.sync_timers),
+            device_index=parallel.placement_device,
         )
 
     @_contextmanager
@@ -611,9 +625,28 @@ class EngineRuntime:
             _active_activations[0] += 1
         _apply_cache_settings((self.cache_enabled, self.cache_dir))
         stack.append(self)
+        device_ctx = None
+        if self.device_index is not None:
+            # Fleet placement: pin jax's (thread-local) default device so
+            # this runtime's dispatches land on its replica's mesh device.
+            # Arrays stay uncommitted — a graph buffer created under another
+            # replica's activation is transferred, never rejected — so
+            # replicas may legally share input graphs.
+            try:
+                import jax
+
+                devs = jax.devices()
+                device_ctx = jax.default_device(
+                    devs[self.device_index % len(devs)]
+                )
+                device_ctx.__enter__()
+            except Exception:  # pragma: no cover — placement is a locality
+                device_ctx = None  # optimization, never a correctness gate
         try:
             yield self
         finally:
+            if device_ctx is not None:
+                device_ctx.__exit__(None, None, None)
             stack.pop()
             with _cache_lock:
                 _active_activations[0] -= 1
@@ -727,6 +760,63 @@ class ServeContext:
 
 
 @dataclass
+class FleetContext:
+    """Knobs of the mesh-replicated serve fleet (round 18,
+    :mod:`kaminpar_tpu.serve.fleet`).
+
+    A :class:`~kaminpar_tpu.serve.fleet.PartitionFleet` owns N
+    :class:`~kaminpar_tpu.serve.PartitionEngine` replicas — one per mesh
+    device by default — and steers each request to a replica with an
+    SLO-aware score over the replicas' live serving signals (queue drain
+    estimate, p99 execute, open breakers, capacity-preflight verdict)
+    instead of a single EMA.  Same-cell load fans in per replica up to the
+    engine's ``max_batch`` (the lane axis) before spilling to the next
+    replica (the device axis) — the lane x device 2D plane."""
+
+    # Replica count; 0 = one per visible jax device (the whole local mesh).
+    replicas: int = 0
+    # Graph-id-sticky routing: a request carrying ``graph_id`` keeps landing
+    # on the replica that first served that id while it stays healthy, so a
+    # tenant's warm graph state (and, once incremental repartitioning
+    # lands, its resident delta-graph) stays on one device.
+    sticky_routing: bool = True
+    # Steering-score weights: queue term (drain-time estimate of the
+    # replica's queued work) and tail-latency term (p99 execute seconds).
+    steer_queue_weight: float = 1.0
+    steer_p99_weight: float = 1.0
+    # Score bonus (in service-time units) for joining a replica's *forming*
+    # same-cell batch (0 < same-cell depth < max_batch): fills the lane
+    # axis to max_batch before spilling to the next device, maximizing
+    # stacked occupancy.  >= (max_batch-1)/max_batch keeps a forming batch
+    # preferred over an idle sibling until it is full.
+    batch_join_bonus: float = 1.0
+    # Floor for the per-request service-time estimate used by the steering
+    # score and the fleet drain estimate before any EMA exists.
+    steer_service_floor_s: float = 0.05
+    # Cross-replica requeue budget per request: how many times a request
+    # force-resolved by a draining/hung replica (typed EngineStoppedError /
+    # WorkerHung / watchdog ExecuteFault) is resubmitted elsewhere before
+    # the typed error surfaces to the caller.
+    max_resteers: int = 2
+    # Drain a replica automatically when its watchdog fires or when at
+    # least ``auto_drain_open_cells`` of its cell breakers latch open
+    # (0 disables auto-drain; ``drain_replica`` stays available).
+    auto_drain: bool = True
+    auto_drain_open_cells: int = 2
+    # Fleet-scoped replica breaker: a drained replica re-admits one probe
+    # request after this cooldown (restart + half-open, like every other
+    # ladder rung).
+    replica_cooldown_s: float = 30.0
+    # Warm-cache inheritance: replica N+1 shares the fleet's persistent
+    # compilation cache dir and imports the warmup report of the first
+    # warmed replica, skipping every cell already traced (inherited vs
+    # locally-compiled counts ride warmup_report and Prometheus).
+    inherit_warm_cache: bool = True
+    # Bounded per-replica drain budget used by drain_replica/shutdown.
+    drain_timeout_s: float = 30.0
+
+
+@dataclass
 class ResilienceContext:
     """Knobs of the unified resilience layer (round 17,
     :mod:`kaminpar_tpu.resilience`): fault injection, circuit breakers,
@@ -819,6 +909,7 @@ class Context:
         default_factory=GraphCompressionContext
     )
     serve: ServeContext = field(default_factory=ServeContext)
+    fleet: FleetContext = field(default_factory=FleetContext)
     resilience: ResilienceContext = field(default_factory=ResilienceContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0

@@ -54,6 +54,13 @@ LADDER = {
     "ip_device": "ip_host",
     "quality_strong": "quality_fast",
     "cell": "reject",
+    # Fleet tier (round 18, serve/fleet.py): a replica whose watchdog
+    # trips or whose cell breakers latch open is drained and its work
+    # resteered to healthy replicas; the half-open probe restarts it.
+    # Lives on the FLEET-scoped registry (cell = (replica_index,)), while
+    # the rungs above live on each replica's engine-scoped registry or the
+    # process-global pipeline registry.
+    "replica": "resteer",
 }
 
 DEFAULT_THRESHOLD = 3
@@ -115,6 +122,20 @@ class CircuitBreaker:
                 self._probe_deadline = now + self.cooldown_s
                 return True
             return False
+
+    def would_allow(self, now: Optional[float] = None) -> bool:
+        """:meth:`allow` as a pure peek — same decision, but never
+        consumes the probe slot or mutates state.  Callers that may still
+        filter the path out after this check (the fleet router's
+        candidate scan) peek first and consume only when the path is
+        actually dispatched."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                return now >= self._open_until
+            return now >= self._probe_deadline  # half-open: stale probe
 
     def retry_after_s(self, now: Optional[float] = None) -> float:
         now = time.monotonic() if now is None else now
@@ -191,16 +212,24 @@ class CircuitBreaker:
 
 class BreakerRegistry:
     """Lazily-created breakers keyed by (path, cell) + the demotion
-    census of the degradation ladder."""
+    census of the degradation ladder.
+
+    ``scope`` names which tier owns the registry (round 18): "engine" for
+    a replica's private serve-tier breakers, "pipeline" for the
+    process-global registry, "fleet" for the fleet router's replica
+    breakers — surfaced as a label on every breaker Prometheus sample so a
+    fleet's merged exposition stays attributable."""
 
     def __init__(self, threshold: Optional[int] = None,
-                 cooldown_s: Optional[float] = None):
+                 cooldown_s: Optional[float] = None,
+                 scope: str = "engine"):
         self.threshold = (
             _default_threshold() if threshold is None else int(threshold)
         )
         self.cooldown_s = (
             _default_cooldown() if cooldown_s is None else float(cooldown_s)
         )
+        self.scope = str(scope)
         self._lock = threading.Lock()
         self._breakers: Dict[Tuple, CircuitBreaker] = {}
         self._demotions: Dict[str, int] = {}
@@ -253,6 +282,17 @@ class BreakerRegistry:
         with self._lock:
             return dict(self._demotions)
 
+    def open_count(self, path: Optional[str] = None) -> int:
+        """Breakers currently NOT closed (open or half-open), optionally
+        filtered by rung — the fleet router's replica-health signal (a
+        replica with several latched-open cell breakers gets drained)."""
+        with self._lock:
+            breakers = list(self._breakers.items())
+        return sum(
+            1 for (p, _cell), br in breakers
+            if (path is None or p == path) and br.state != "closed"
+        )
+
     def snapshot(self) -> dict:
         with self._lock:
             breakers = {
@@ -262,6 +302,7 @@ class BreakerRegistry:
             demotions = dict(self._demotions)
             restorations = dict(self._restorations)
         return {
+            "scope": self.scope,
             "threshold": self.threshold,
             "cooldown_s": self.cooldown_s,
             "breakers": {name: br.snapshot() for name, br in breakers.items()},
@@ -288,7 +329,7 @@ def global_registry() -> BreakerRegistry:
     rungs.  Created lazily so env-tuned defaults apply."""
     with _global_lock:
         if _global[0] is None:
-            _global[0] = BreakerRegistry()
+            _global[0] = BreakerRegistry(scope="pipeline")
         return _global[0]
 
 
@@ -308,9 +349,10 @@ def prometheus_families(*registries, prefix: str = "kaminpar_resilience") -> lis
     merged_restore: Dict[str, int] = {}
     for reg in registries:
         snap = reg.snapshot()
+        scope = snap.get("scope", "engine")
         for name, br in snap["breakers"].items():
             path, _, cell = name.partition("|")
-            labels = {"path": path, "cell": cell}
+            labels = {"path": path, "cell": cell, "scope": scope}
             state_samples.append((labels, state_code.get(br["state"], -1)))
             trip_samples.append((labels, br["trips"]))
         for path, count in snap["demotions"].items():

@@ -18,6 +18,7 @@ from .batching import (
     unpack_partition,
 )
 from .engine import PartitionEngine, ServeFuture, ServeRequest, ServeResult
+from .fleet import FleetFuture, PartitionFleet
 from .lanestack import LaneStackReport, LaneStackUnsupported, run_lanestacked
 from .errors import (
     CapacityError,
@@ -35,10 +36,12 @@ __all__ = [
     "CapacityError",
     "DeadlineExceededError",
     "EngineStoppedError",
+    "FleetFuture",
     "LaneStackReport",
     "LaneStackUnsupported",
     "PackedBatch",
     "PartitionEngine",
+    "PartitionFleet",
     "run_lanestacked",
     "QueueFullError",
     "RequestCancelledError",

@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-window-ms", type=float, default=None)
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="per-request deadline (0 = none)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="serve through a PartitionFleet of N per-device "
+                        "engine replicas (round 18; 0 = single engine, "
+                        "-1 = one replica per visible device)")
     p.add_argument("--warmup-only", action="store_true")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--demo", type=int, default=16, metavar="N",
@@ -115,13 +119,25 @@ def main(argv=None) -> int:
         val = getattr(args, flag)
         if val is not None:
             overrides[knob] = val
-    engine = PartitionEngine(ctx, **overrides)
+    if args.fleet:
+        # Fleet mode (round 18): N per-device replicas behind the
+        # shape-cell router; the metrics endpoint serves the FLEET
+        # exposition (per-replica expositions stay available in-process).
+        from .fleet import PartitionFleet
+
+        engine = PartitionFleet(
+            ctx, replicas=(None if args.fleet < 0 else args.fleet),
+            **overrides,
+        )
+    else:
+        engine = PartitionEngine(ctx, **overrides)
     from ..telemetry import trace as ttrace
 
     rec = None
     if args.trace_out:
         rec = ttrace.start()
-        rec.meta.update({"mode": "serve", "preset": args.preset})
+        rec.meta.update({"mode": "serve", "preset": args.preset,
+                         "fleet": int(args.fleet)})
     metrics_server = None
     try:
         # Inside the try: a failed warmup or an already-bound metrics port
@@ -132,8 +148,14 @@ def main(argv=None) -> int:
             print(f"metrics: http://127.0.0.1:{args.metrics_port}/metrics",
                   file=sys.stderr)
         if args.warmup_only:
-            print(json.dumps({"warmup": engine.warmup_report,
-                              "stats": engine.stats()}, default=str))
+            if args.fleet:
+                print(json.dumps({
+                    "warmup": [r.warmup_report for r in engine.replicas],
+                    "stats": engine.stats(),
+                }, default=str))
+            else:
+                print(json.dumps({"warmup": engine.warmup_report,
+                                  "stats": engine.stats()}, default=str))
             return 0
         if args.graphs:
             from .. import io as kio

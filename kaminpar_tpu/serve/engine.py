@@ -199,9 +199,15 @@ class PartitionEngine:
     def __init__(
         self,
         ctx: Union[Context, str, None] = None,
+        name: str = "",
         **serve_overrides,
     ):
         from ..presets import create_context_by_preset_name
+
+        # Replica tag (round 18, serve/fleet.py): names the dispatcher
+        # thread (so per-replica trace lanes fall out of the trace
+        # recorder's thread_name metadata) and prefixes log/warning text.
+        self.name = str(name)
 
         if ctx is None:
             ctx = create_context_by_preset_name("serve")
@@ -264,6 +270,13 @@ class PartitionEngine:
         )
         self.watchdog = ExecutionWatchdog(self.resilience.dossier_path)
         self.warmup_report: List[dict] = []
+        # Warm-cache inheritance (round 18, serve/fleet.py): True once
+        # inherit_warmup imported another replica's warm state — the
+        # warmup passes then skip every inherited cell (and the aux
+        # passes entirely: their executables are process-warm from the
+        # source replica, and the shared persistent cache dir covers the
+        # cross-process case).
+        self._inherited = False
         # Requests currently being executed by the dispatcher (the bounded
         # shutdown force-resolves these when the worker dies mid-batch).
         self._inflight: List[ServeRequest] = []
@@ -350,8 +363,11 @@ class PartitionEngine:
                 self._disarm_faults()
                 raise
             self._running = True
+            thread_name = "kaminpar-serve-dispatch" + (
+                f"-{self.name}" if self.name else ""
+            )
             self._thread = threading.Thread(
-                target=self._loop, name="kaminpar-serve-dispatch", daemon=True
+                target=self._loop, name=thread_name, daemon=True
             )
             self._thread.start()
         return self
@@ -388,11 +404,14 @@ class PartitionEngine:
             self._device_kind
         )
 
-    def _capacity_preflight(self, graph, k: int) -> None:
-        """Reject a predicted-oversize request with :class:`CapacityError`
-        BEFORE it is queued (and long before anything compiles) — pure
-        host arithmetic over the graph's padded shape cell (ISSUE 12; the
-        first piece of the ROADMAP serve-fleet SLO-aware admission)."""
+    def _run_preflight(self, graph, k: int) -> None:
+        """The one capacity-preflight invocation (ISSUE 12): raises
+        :class:`CapacityError` when the predicted watermark exceeds this
+        engine's ceiling; returns silently when the preflight is off or
+        no ceiling is knowable.  Shared by the counting admission path
+        (:meth:`_capacity_preflight`) and the fleet router's non-counting
+        steering probe (:meth:`capacity_verdict`) so the two can never
+        diverge on what "fits" means."""
         mode = str(
             getattr(self.serve, "capacity_preflight", "auto")
         ).strip().lower()
@@ -402,33 +421,72 @@ class PartitionEngine:
         from ..utils.timer import scoped_timer
 
         with scoped_timer("capacity_preflight"):
-            try:
-                capacity.preflight(
-                    graph, k,
-                    ceiling_bytes=self._capacity_ceiling,
-                    device_kind=self._device_kind,
-                    device_decode=(
-                        self.ctx.compression.enabled
-                        and str(self.ctx.compression.device_decode) != "off"
-                    ),
-                )
-            except CapacityError:
-                self.stats_.bump("rejected_capacity")
-                from ..telemetry import trace as ttrace
+            capacity.preflight(
+                graph, k,
+                ceiling_bytes=self._capacity_ceiling,
+                device_kind=self._device_kind,
+                device_decode=(
+                    self.ctx.compression.enabled
+                    and str(self.ctx.compression.device_decode) != "off"
+                ),
+            )
 
-                rec = ttrace.active()
-                if rec is not None:
-                    rec.instant(
-                        "serve.reject_capacity", k=int(k),
-                        ceiling_bytes=self._capacity_ceiling,
-                    )
-                raise
+    def _capacity_preflight(self, graph, k: int) -> None:
+        """Reject a predicted-oversize request with :class:`CapacityError`
+        BEFORE it is queued (and long before anything compiles) — pure
+        host arithmetic over the graph's padded shape cell (ISSUE 12; the
+        first piece of the ROADMAP serve-fleet SLO-aware admission)."""
+        try:
+            self._run_preflight(graph, k)
+        except CapacityError:
+            self.stats_.bump("rejected_capacity")
+            from ..telemetry import trace as ttrace
+
+            rec = ttrace.active()
+            if rec is not None:
+                rec.instant(
+                    "serve.reject_capacity", k=int(k),
+                    ceiling_bytes=self._capacity_ceiling,
+                )
+            raise
+
+    def inherit_warmup(self, source: "PartitionEngine") -> None:
+        """Import another replica's warm state (round 18 warm-cache
+        inheritance): its warmup-report rows land here marked
+        ``inherited=True`` with zero wall/compile cost, its warm cells /
+        (n, k, tier) pairs / lane-stack layout keys seed this engine's
+        warm-accounting sets, and its service-time EMA seeds the
+        retry-after estimate.  A subsequent ``start(warmup=True)`` then
+        skips every inherited cell — replica N+1 pays zero synthetic
+        partitions for cells the fleet already traced (the compiled
+        executables are shared in-process, and the shared persistent
+        cache dir covers a fresh process).  Must be called before
+        :meth:`start`; inherited-vs-local counts ride ``warmup_report``,
+        ``stats()`` and the Prometheus exposition."""
+        for row in source.warmup_report:
+            inherited = dict(row)
+            inherited["inherited"] = True
+            # The cost was paid by the source replica, not this one.
+            inherited["wall_s"] = 0.0
+            inherited["backend_compile_s"] = 0.0
+            inherited["trace_s"] = 0.0
+            self.warmup_report.append(inherited)
+        self._warm_cells |= source._warm_cells
+        self._warm_nk |= source._warm_nk
+        self._warm_stack_keys |= source._warm_stack_keys
+        ema = source.stats_.service_time_estimate()
+        if ema > 0.0:
+            self.stats_.seed_service_time(ema)
+        self._inherited = True
 
     def _warmup(self) -> None:
         """Trace/compile the executable set over warm_ladder x warm_ks by
         running one synthetic RMAT partition per cell; every padded bucket
         the hierarchy visits below each rung gets warmed too.  Per-cell
-        wall + compile/trace seconds come from utils/compile_stats."""
+        wall + compile/trace seconds come from utils/compile_stats.
+        Cells already imported via :meth:`inherit_warmup` are skipped —
+        their inherited report rows are in place and the executables are
+        warm from the source replica."""
         from ..graph.generators import rmat_graph
         from ..utils import compile_stats
 
@@ -462,6 +520,8 @@ class PartitionEngine:
                 if k > (1 << scale):
                     continue
                 cell = shape_cell(g, k)
+                if self._inherited and cell in self._warm_cells:
+                    continue  # imported from the fleet — already traced
                 before = compile_stats.compile_time_snapshot()
                 t0 = time.perf_counter()
                 try:
@@ -505,16 +565,25 @@ class PartitionEngine:
                         row["census"] = census_row
                 self.warmup_report.append(row)
                 self._note_warm(cell)
-        self._warm_ip_pool(rung_graph)
-        self._warm_lanestack(rung_graph)
-        self._warm_compressed(rung_graph)
+        if not self._inherited:
+            # Inherited engines skip the aux passes: the ip-pool /
+            # lane-stack / compressed executables are process-warm from
+            # the source replica's passes (and rode the inherited report
+            # rows above); re-running them per replica would pay the
+            # synthetic partitions N times for one executable set.
+            self._warm_ip_pool(rung_graph)
+            self._warm_lanestack(rung_graph)
+            self._warm_compressed(rung_graph)
         # Seed the retry-after service-time EMA from the warm execution
         # cost (wall minus compile/trace — the steady-state share) so the
         # very first admission rejects carry a real estimate instead of
-        # the blind floor (ISSUE 6 satellite).
+        # the blind floor (ISSUE 6 satellite).  Inherited rows carry zero
+        # wall (the source paid it) and must not dilute the mean — the
+        # inherit path seeds the EMA from the source's instead.
         execs = [
             max(r["wall_s"] - r["backend_compile_s"] - r["trace_s"], 1e-3)
-            for r in self.warmup_report if "kind" not in r
+            for r in self.warmup_report
+            if "kind" not in r and not r.get("inherited")
         ]
         if execs:
             self.stats_.seed_service_time(float(np.mean(execs)))
@@ -765,12 +834,16 @@ class PartitionEngine:
         return self._running
 
     def pause(self) -> None:
-        """Hold the dispatcher before its next batch (maintenance window;
-        queued work waits, admission stays open up to the queue bound)."""
+        """Hold the dispatcher (maintenance window; queued work waits IN
+        the queue — where a fleet drain can requeue it — and admission
+        stays open up to the queue bound).  Takes effect before the next
+        batch is *extracted*: the gate-aware pop leaves work queued, so a
+        paused burst accumulates to full batches."""
         self._gate.clear()
 
     def resume(self) -> None:
         self._gate.set()
+        self._queue.poke()
 
     def shutdown(self, drain: bool = True, timeout_s: Optional[float] = None) -> None:
         """Stop the engine.  ``drain=True`` serves everything already
@@ -975,7 +1048,8 @@ class PartitionEngine:
         while True:
             self._gate.wait()
             batch = self._queue.pop_batch(
-                self.serve.max_batch, self.serve.batch_window_ms / 1e3
+                self.serve.max_batch, self.serve.batch_window_ms / 1e3,
+                gate=self._gate,
             )
             if batch is None:
                 return  # closed + drained: graceful exit
@@ -1130,6 +1204,7 @@ class PartitionEngine:
                 parts, report = run_lanestacked(
                     self._solver.ctx, [r.graph for r in live],
                     live[0].k, live[0].epsilon,
+                    trace_lane=self.name,
                 )
         except LaneStackUnsupported as exc:
             self._lanestack_fallback(
@@ -1407,6 +1482,50 @@ class PartitionEngine:
 
     # -- observability -----------------------------------------------------
 
+    def steer_signals(self) -> dict:
+        """Cheap live serving signals for the fleet router (round 18) —
+        queue depth, the unamortized service-time EMA, p99 execute
+        seconds, open-breaker counts, watchdog fires — WITHOUT the full
+        snapshot's compile/sync census cost.  Pure host reads."""
+        return {
+            "running": self._running,
+            "queue_depth": len(self._queue),
+            "ema_service_s": self.stats_.service_time_estimate(),
+            "p99_execute_s": self.stats_.execute_p99_s(),
+            "open_breakers": self.breakers.open_count(),
+            "open_cell_breakers": self.breakers.open_count("cell"),
+            "watchdog_timeouts": self.stats_.counter("watchdog_timeouts"),
+            "max_batch": self.serve.max_batch,
+        }
+
+    def cell_depth(self, cell: ShapeCell) -> int:
+        """Queued same-cell requests (the router's batch-join signal)."""
+        return self._queue.cell_depth(cell)
+
+    def capacity_verdict(self, graph, k: int) -> bool:
+        """Would the admission preflight accept this request?  A pure
+        non-raising, non-counting probe for the fleet router's steering
+        score — per-replica ceilings can differ, so a request a small
+        replica must reject may still be steerable to a bigger one.  True
+        when no ceiling is knowable (preflight off).  Same invocation as
+        the admission path (:meth:`_run_preflight`)."""
+        try:
+            self._run_preflight(graph, k)
+        except CapacityError:
+            return False
+        return True
+
+    def warmup_cell_counts(self) -> dict:
+        """Inherited vs locally-compiled warmup cells (round 18 warm-cache
+        inheritance; printed by ``tools warmup --fleet``)."""
+        inherited = sum(
+            1 for r in self.warmup_report if r.get("inherited")
+        )
+        return {
+            "inherited": inherited,
+            "local": len(self.warmup_report) - inherited,
+        }
+
     def stats(self) -> dict:
         """Structured snapshot: queue depth, admission/reject/timeout
         counts, batch occupancy, warm-cache hit rate, latency percentiles,
@@ -1415,6 +1534,7 @@ class PartitionEngine:
         snap["running"] = self._running
         snap["warm_cells"] = len(self._warm_cells)
         snap["warmup"] = list(self.warmup_report)
+        snap["warmup_cells"] = self.warmup_cell_counts()
         # Resilience surface (round 17): this engine's breaker registry
         # (lanestack/cell/quality rungs), the process-global pipeline
         # registry (lp_pallas/ip_device/device_decode rungs), the
@@ -1463,5 +1583,15 @@ class PartitionEngine:
             "Execution-watchdog deadline overruns converted into breaker "
             "trips + typed future resolutions",
             [({}, self.watchdog.fired)],
+        ))
+        # Warm-cache inheritance census (round 18): how many warmup cells
+        # this replica inherited from the fleet vs compiled locally.
+        cells = self.warmup_cell_counts()
+        families.append((
+            "kaminpar_serve_warmup_cells_total", "counter",
+            "Warmup-report cells by source: inherited from the fleet's "
+            "warm state vs locally traced/compiled",
+            [({"source": "inherited"}, cells["inherited"]),
+             ({"source": "local"}, cells["local"])],
         ))
         return prometheus.render(families)

@@ -1014,9 +1014,17 @@ class LaneStackRunner:
             return results
 
 
-def run_lanestacked(ctx: Context, graphs: Sequence, k: int, epsilon: float):
+def run_lanestacked(ctx: Context, graphs: Sequence, k: int, epsilon: float,
+                    trace_lane: str = ""):
     """Execute a same-cell batch lane-stacked; returns (partitions, report).
-    Raises :class:`LaneStackUnsupported` for out-of-envelope batches."""
+    Raises :class:`LaneStackUnsupported` for out-of-envelope batches.
+
+    ``trace_lane`` (round 18, serve/fleet.py): when set and a trace
+    recorder is active, the whole stacked execution additionally lands as
+    ONE closed span on the named synthetic lane row (``replicaN``), so a
+    fleet trace shows the device axis side by side — which replica ran
+    which stacked batch at what occupancy — without touching the ambient
+    thread rows."""
     from ..resilience.faults import maybe_inject
 
     # Named "execute" injection point of the stacked path (round 17): the
@@ -1025,5 +1033,15 @@ def run_lanestacked(ctx: Context, graphs: Sequence, k: int, epsilon: float):
     # faulted batch leaves no partial per-lane state behind.
     maybe_inject("execute", site="lanestack")
     runner = LaneStackRunner(ctx, graphs, k, epsilon)
+    from ..telemetry import trace as ttrace
+
+    rec = ttrace.active() if trace_lane else None
+    t0 = rec._now_us() if rec is not None else 0.0
     parts = runner.run()
+    if rec is not None:
+        rec.lane_span(
+            trace_lane, "lanestack_batch", t0, rec._now_us(),
+            lanes=runner.report.lanes, cohorts=runner.report.cohorts,
+            splits=runner.report.splits,
+        )
     return parts, runner.report

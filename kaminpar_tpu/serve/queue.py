@@ -35,6 +35,14 @@ class BoundedServeQueue:
         with self._cv:
             return len(self._dq)
 
+    def cell_depth(self, cell) -> int:
+        """Queued requests in ``cell`` — the fleet router's batch-join
+        signal (a replica with a *forming* same-cell batch, 0 < depth <
+        max_batch, is preferred so the lane axis fills before load spills
+        to the next device)."""
+        with self._cv:
+            return sum(1 for r in self._dq if r.cell == cell)
+
     @property
     def closed(self) -> bool:
         with self._cv:
@@ -51,35 +59,67 @@ class BoundedServeQueue:
             self._dq.append(item)
             self._cv.notify_all()
 
-    def pop_batch(self, max_batch: int, window_s: float = 0.0) -> Optional[List]:
+    def pop_batch(self, max_batch: int, window_s: float = 0.0,
+                  gate=None) -> Optional[List]:
         """Block until a request is available, then return a same-cell batch.
 
         The head request's cell seeds the batch; if fewer than ``max_batch``
         same-cell requests are queued, waits up to ``window_s`` for more to
         arrive before dispatching.  Returns ``None`` exactly once the queue
         is closed *and* drained (the graceful-shutdown termination signal).
+
+        ``gate`` (round 18): an optional ``threading.Event`` — while it is
+        cleared no batch is extracted, so ``PartitionEngine.pause`` holds
+        work IN the queue (where a fleet drain can requeue it and a burst
+        accumulates to full batches) instead of merely delaying the batch
+        after extraction.  Ignored once the queue closes (drain proceeds);
+        setters must call :meth:`poke` to wake the consumer.
         """
         max_batch = max(1, int(max_batch))
         with self._cv:
-            while not self._dq:
-                if self._closed:
-                    return None
-                self._cv.wait()
-            cell = self._dq[0].cell
-            deadline = time.monotonic() + max(0.0, float(window_s))
-            while not self._closed:
-                if sum(1 for r in self._dq if r.cell == cell) >= max_batch:
-                    break
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._cv.wait(remaining)
-            # One batching policy for the whole runtime: the head-seeded
-            # same-cell selection lives in batching.form_batches.
-            batch = form_batches(self._dq, max_batch)[0]
-            taken = set(map(id, batch))
-            self._dq = deque(r for r in self._dq if id(r) not in taken)
-            return batch
+            while True:
+                while not self._dq or (
+                    gate is not None and not gate.is_set()
+                    and not self._closed
+                ):
+                    if self._closed and not self._dq:
+                        return None
+                    self._cv.wait()
+                cell = self._dq[0].cell
+                deadline = time.monotonic() + max(0.0, float(window_s))
+                while not self._closed:
+                    if sum(1 for r in self._dq if r.cell == cell) >= max_batch:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                if not self._dq:
+                    # drain_items emptied the queue while the batch window
+                    # waited (a fleet drain requeuing this replica's work,
+                    # round 18) — go back to blocking for fresh work.
+                    continue
+                if (
+                    gate is not None and not gate.is_set()
+                    and not self._closed
+                ):
+                    # pause() landed during the batch window: hold the
+                    # work IN the queue (the documented pause contract —
+                    # a drain can still requeue it) instead of extracting
+                    # a batch for a paused dispatcher.
+                    continue
+                # One batching policy for the whole runtime: the head-seeded
+                # same-cell selection lives in batching.form_batches.
+                batch = form_batches(self._dq, max_batch)[0]
+                taken = set(map(id, batch))
+                self._dq = deque(r for r in self._dq if id(r) not in taken)
+                return batch
+
+    def poke(self) -> None:
+        """Wake blocked consumers to re-check external state (the pause
+        gate) — called by ``PartitionEngine.resume``."""
+        with self._cv:
+            self._cv.notify_all()
 
     def close(self) -> None:
         """Stop admissions; consumers drain the remainder then get None."""

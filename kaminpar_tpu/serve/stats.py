@@ -134,6 +134,21 @@ class ServeStats:
             if self.ema_service_s == 0.0 and seconds > 0.0:
                 self.ema_service_s = float(seconds)
 
+    def execute_p99_s(self) -> float:
+        """p99 of the execute-stage reservoir in SECONDS (0.0 before any
+        sample) — the fleet router's tail-latency steering term, read
+        without materializing the full snapshot."""
+        with self._lock:
+            summary = self._lat["execute_ms"].summary()
+        return float(summary.get("p99", 0.0)) / 1e3
+
+    def service_time_estimate(self) -> float:
+        """The smoothed UNAMORTIZED per-request service seconds (the EMA
+        the retry-after estimate divides by the batch width; 0.0 before
+        any completion or warmup seed)."""
+        with self._lock:
+            return float(self.ema_service_s)
+
     def retry_after_estimate(self, queue_depth: int, max_batch: int) -> float:
         """Backpressure hint: depth x smoothed service time / batch width,
         floored so callers never busy-spin on a zero.  The EMA is seeded

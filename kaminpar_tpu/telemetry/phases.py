@@ -91,6 +91,12 @@ AUX_PHASES = (
     # (reads phase boards + /proc, never the device).
     "capacity_preflight",
     "heartbeat",
+    # Mesh-replicated serve fleet (round 18, serve/fleet.py): the router's
+    # steering decision — pure host arithmetic over the replicas' live
+    # serving signals (queue drain estimate, p99 execute, open breakers,
+    # capacity verdict); a pull under this phase is a contract violation
+    # and would be attributed loudly.
+    "fleet_steer",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

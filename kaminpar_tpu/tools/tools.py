@@ -147,30 +147,67 @@ def warmup(argv) -> int:
                    help="comma-separated lane counts to warm the "
                         "lane-stacked serve pipeline at (round 11; empty "
                         "skips the lane-stack warm pass)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="warm an N-replica PartitionFleet instead of one "
+                        "engine (round 18): replica 0 pays the ladder, "
+                        "replicas 1..N-1 inherit its warm state; prints "
+                        "per-replica inherited vs locally-compiled cells "
+                        "(-1 = one replica per visible device)")
     args = p.parse_args(argv)
-    from ..serve.engine import PartitionEngine
     from ..utils import compile_stats
 
-    engine = PartitionEngine(
-        args.preset,
+    kwargs = dict(
         warm_ladder=tuple(int(s) for s in args.ladder.split(",") if s.strip()),
         warm_ks=tuple(int(s) for s in args.ks.split(",") if s.strip()),
         warm_edge_factor=args.edge_factor,
         warm_lanes=tuple(int(s) for s in args.lanes.split(",") if s.strip()),
     )
-    engine.start(warmup=True)
-    try:
+
+    def _print_report(report, indent="  "):
         total_wall = 0.0
-        print(f"warmup ({args.preset} preset):")
-        for row in engine.warmup_report:
+        for row in report:
             total_wall += row["wall_s"]
             kind = row.get("kind", "pipeline")
             lanes = f" lanes={row['lanes']}" if "lanes" in row else ""
-            print(f"  {kind} cell n_bucket={row['n_bucket']} "
+            src = " [inherited]" if row.get("inherited") else ""
+            print(f"{indent}{kind} cell n_bucket={row['n_bucket']} "
                   f"m_bucket={row['m_bucket']} k={row['k']}{lanes}: "
                   f"{row['wall_s']:.2f} s "
                   f"(compile {row['backend_compile_s']:.2f} s, "
-                  f"trace {row['trace_s']:.2f} s)")
+                  f"trace {row['trace_s']:.2f} s){src}")
+        return total_wall
+
+    if args.fleet:
+        from ..serve.fleet import PartitionFleet
+
+        fleet = PartitionFleet(
+            args.preset,
+            replicas=(None if args.fleet < 0 else args.fleet),
+            **kwargs,
+        )
+        fleet.start(warmup=True)
+        try:
+            print(f"fleet warmup ({args.preset} preset, "
+                  f"{len(fleet.replicas)} replicas):")
+            for i, eng in enumerate(fleet.replicas):
+                cells = eng.warmup_cell_counts()
+                print(f"  replica {i}: {cells['local']} locally compiled, "
+                      f"{cells['inherited']} inherited")
+                _print_report(eng.warmup_report, indent="    ")
+            snap = compile_stats.snapshot()
+            print(f"  {snap.get('total', 0)} distinct kernel "
+                  "specializations process-wide")
+        finally:
+            fleet.shutdown(drain=False)
+        return 0
+
+    from ..serve.engine import PartitionEngine
+
+    engine = PartitionEngine(args.preset, **kwargs)
+    engine.start(warmup=True)
+    try:
+        print(f"warmup ({args.preset} preset):")
+        total_wall = _print_report(engine.warmup_report)
         snap = compile_stats.snapshot()
         print(f"  total: {total_wall:.2f} s over {len(engine.warmup_report)} "
               f"cells, {snap.get('total', 0)} distinct kernel specializations")

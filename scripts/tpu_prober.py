@@ -163,6 +163,18 @@ def child_attempt() -> None:
     os.environ.setdefault("KPTPU_BENCH_SHARD_SCALE", "12")
     if len(devs) >= 8:
         os.environ.setdefault("KPTPU_BENCH_SHARD_NATIVE", "1")
+    # Mesh-replicated serve-fleet A/B (ISSUE 14) rides run_benchmark's
+    # phase 6 in its own child: one warm engine vs P per-device replicas
+    # behind the SLO-aware router, at a modest on-silicon workload.  On a
+    # multi-chip host the fleet measures the REAL device axis
+    # (KPTPU_BENCH_FLEET_NATIVE=1 — this is where the aggregate-throughput
+    # claim stops being a dryrun); single-chip windows carry the virtual
+    # CPU-mesh routing/occupancy/bit-identity record.
+    os.environ.setdefault("KPTPU_BENCH_FLEET", "1")
+    os.environ.setdefault("KPTPU_BENCH_FLEET_SCALE", "10")
+    os.environ.setdefault("KPTPU_BENCH_FLEET_REQS", "32")
+    if len(devs) >= 8:
+        os.environ.setdefault("KPTPU_BENCH_FLEET_NATIVE", "1")
     # Run telemetry (ISSUE 5): the full-partition phase records the unified
     # trace on-silicon; its summary (trace path, per-level quality rows,
     # HBM watermark) rides the salvaged record into TPU_RESULT.json and

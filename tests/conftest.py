@@ -85,6 +85,17 @@ def pytest_sessionfinish(session, exitstatus):
         from kaminpar_tpu.telemetry import ledger
 
         slowest = sorted(_tier1["durations"], reverse=True)[:20]
+        # Per-module wall rollup (round 18): the fleet suite joined the
+        # tier-1 budget — a per-file view catches a single suite creeping
+        # toward the 870 s ceiling before the total does.
+        module_walls: dict = {}
+        for dur, nid in _tier1["durations"]:
+            module_walls[nid.split("::")[0]] = (
+                module_walls.get(nid.split("::")[0], 0.0) + dur
+            )
+        top_modules = sorted(
+            module_walls.items(), key=lambda kv: kv[1], reverse=True
+        )[:10]
         record = {
             "backend": "cpu",
             "tier1_wall_s": round(time.time() - _tier1["t0"], 1),
@@ -93,9 +104,16 @@ def pytest_sessionfinish(session, exitstatus):
         }
         entry = ledger.build_entry(
             record, kind="tier1",
-            extra={"slowest": [
-                {"nodeid": nid, "s": round(dur, 2)} for dur, nid in slowest
-            ]},
+            extra={
+                "slowest": [
+                    {"nodeid": nid, "s": round(dur, 2)}
+                    for dur, nid in slowest
+                ],
+                "module_walls": [
+                    {"module": mod, "s": round(wall, 1)}
+                    for mod, wall in top_modules
+                ],
+            },
         )
         ledger.append(entry)
     except Exception:  # noqa: BLE001 — the wall watch must never fail a run
