@@ -1137,6 +1137,25 @@ def run_fleet_phase(record: dict | None = None) -> dict:
             "inherited_cells": inherited_total,
             "per_replica": per_replica,
         }
+        # Elastic exercise (ISSUE 15): one scale-down/scale-up cycle on
+        # the live fleet — the drained replica's work resteers, the
+        # revived slot restarts warm — so the fleet_scale_* ledger keys
+        # measure a REAL drain/revive, not untouched zeros.
+        if P >= 2:
+            t0 = time.perf_counter()
+            fleet.scale_to(P - 1, reason="bench elastic cycle")
+            down_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fleet.scale_to(P, reason="bench elastic cycle")
+            up_s = time.perf_counter() - t0
+            sstats = fleet.stats()
+            ab["fleet"]["scale"] = {
+                "down_s": round(down_s, 3),
+                "up_s": round(up_s, 3),
+                "active_after": sstats["active_replicas"],
+                **{key: val for key, val in sstats.items()
+                   if key.startswith("fleet_scale_")},
+            }
     finally:
         fleet.shutdown(drain=True)
 
@@ -1163,6 +1182,16 @@ def run_fleet_phase(record: dict | None = None) -> dict:
         ),
         "fleet_warmup_s": ab["fleet"]["warmup_s"],
     })
+    # Elastic-cycle ledger keys (ISSUE 15): scale walls + the census the
+    # cycle produced (retire/revive counts; zero lost resolutions is
+    # asserted by the test matrix, the bench records the cost).
+    if "scale" in ab["fleet"]:
+        record.update({
+            "fleet_scale_down_s": ab["fleet"]["scale"]["down_s"],
+            "fleet_scale_up_s": ab["fleet"]["scale"]["up_s"],
+            "fleet_scale_retires": ab["fleet"]["scale"]["fleet_scale_retires"],
+            "fleet_scale_revives": ab["fleet"]["scale"]["fleet_scale_revives"],
+        })
     print(json.dumps(record, default=str), flush=True)
     return record
 

@@ -304,15 +304,24 @@ class ClusterCoarsener:
         self.hierarchy.append(CoarseLevel(coarse, coarse_of, coarse_comm))
         return True
 
-    def coarsen(self, k: int, epsilon: float, target_n: int) -> CSRGraph:
+    def coarsen(self, k: int, epsilon: float, target_n: int,
+                on_level=None) -> CSRGraph:
         """Coarsen until ``n <= target_n`` or convergence (reference:
         deep_multilevel.cc:86-149 coarsening loop).  The loop condition uses
         ``current_n`` so a compressed-view input is not force-decoded; the
         returned coarsest graph is dense either way (0-level runs
-        materialize the finest via the device decode)."""
+        materialize the finest via the device decode).
+
+        ``on_level`` (round 19): optional callback invoked with the
+        coarsener after each PUSHED level — the deep pipeline's
+        level-boundary checkpoint hook (resilience/checkpoint.py).  A
+        pre-seeded hierarchy (checkpoint restore) simply continues from
+        ``current_n``."""
         while self.current_n > target_n:
             if not self.coarsen_once(k, epsilon):
                 break
+            if on_level is not None:
+                on_level(self)
         return self.current_graph
 
     def uncoarsen(self, partition):

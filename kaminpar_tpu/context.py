@@ -757,6 +757,20 @@ class ServeContext:
     # when the backend exposes one, else the per-device-kind HBM table at
     # the planner's headroom).  Tests pin small values to force rejection.
     capacity_ceiling_bytes: int = 0
+    # Crash-safe serve journal (round 19, serve/journal.py): append-only
+    # JSONL path ("" = off; env KPTPU_SERVE_JOURNAL overrides).  Every
+    # admitted request is journaled at admit (graph payload + params) and
+    # again at resolution; a restarted engine replays unresolved entries
+    # idempotently — restart mid-burst loses zero accepted requests — and
+    # restores the warm state (warmup report, warm cells, breaker trips,
+    # EMA seed) recorded alongside, so the replacement starts warm with a
+    # zero warmup compile-event delta.
+    journal_path: str = ""
+    # fsync the journal every N appended records (durability vs latency;
+    # the un-fsynced suffix is the crash-loss window — TPU_NOTES r19 on
+    # what batched fsync does and does not guarantee).  Resolutions and
+    # the warm-state record force an fsync regardless.
+    journal_fsync_every: int = 8
 
 
 @dataclass
@@ -814,6 +828,30 @@ class FleetContext:
     inherit_warm_cache: bool = True
     # Bounded per-replica drain budget used by drain_replica/shutdown.
     drain_timeout_s: float = 30.0
+    # -- elastic scaling (round 19, ISSUE 15) -------------------------------
+    # ``PartitionFleet.scale_to(N)`` adds/removes replicas under live
+    # traffic: scale-up revives retired slots (warm state carries over)
+    # before spawning fresh replicas (which inherit the fleet's warm
+    # cache); scale-down retires the highest-index active replicas through
+    # the PR 14 drain/resteer machinery — zero lost/duplicated resolutions
+    # (asserted in tests/test_elastic.py).
+    #
+    # ``autoscale`` drives scale_to from the live steer signals: the mean
+    # per-replica queue-drain estimate (depth x unamortized EMA /
+    # max_batch) crossing the high watermark for ``autoscale_hysteresis``
+    # consecutive health sweeps scales up one replica; staying under the
+    # low watermark scales down one (never past the min/max bounds).
+    autoscale: bool = False
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
+    autoscale_high_s: float = 1.0
+    autoscale_low_s: float = 0.05
+    autoscale_hysteresis: int = 3
+    # Replace (not just drain) a replica the health sweep takes out of
+    # rotation — a fresh replica inheriting the fleet's warm state spawns
+    # immediately so capacity does not dip for the drain cooldown.
+    # Implied by ``autoscale``.
+    replace_drained: bool = False
 
 
 @dataclass
@@ -850,6 +888,23 @@ class ResilienceContext:
     # JSONL sidecar for watchdog dossiers ("" = in-memory only; the last
     # 16 ride engine.stats()).
     dossier_path: str = ""
+    # Preemption-tolerant execution (round 19, resilience/checkpoint.py):
+    # directory for deep-pipeline level-boundary checkpoints ("" =
+    # disarmed; env KPTPU_CHECKPOINT arms globally and reaches child
+    # processes).  At every coarsening/uncoarsening level boundary the
+    # resumable state — level-stack CSR arrays (pulled through ONE
+    # counted pull batch under the ``checkpoint_write`` phase), the
+    # current partition, the RNG chain position (seed + draw counter),
+    # and a context fingerprint — is written with an atomic rename;
+    # ``KaMinPar.compute_partition(resume=...)`` / ``tools resume``
+    # validates the fingerprint and continues BIT-IDENTICAL to the
+    # uninterrupted run (asserted in tests/test_checkpoint.py).
+    checkpoint_dir: str = ""
+    # Write a checkpoint every N level boundaries (>= 1).
+    checkpoint_every_levels: int = 1
+    # Keep every boundary's checkpoint file instead of only the latest —
+    # the kill-anywhere test matrix resumes from each of them.
+    checkpoint_keep_all: bool = False
 
 
 @dataclass

@@ -216,6 +216,14 @@ class DeepMultilevelPartitioner:
         self.compressed = compressed
         self.communities = communities
         self.communities_k = communities_k
+        # Preemption tolerance (round 19, resilience/checkpoint.py): the
+        # facade marks its own top-level DEEP run checkpoint-eligible and
+        # may hand it a loaded CheckpointState to resume from.  Nested
+        # constructions (extension subpipelines, v-cycle cycles, dist IP
+        # replicas) never set the flag, so an armed KPTPU_CHECKPOINT can
+        # not make an inner pipeline clobber the outer run's checkpoints.
+        self._checkpoint_top_level = False
+        self.resume_state = None
 
     def _restrict(self, p_graph: PartitionedGraph, pre_part: np.ndarray,
                   cur_k: int, communities):
@@ -338,9 +346,51 @@ class DeepMultilevelPartitioner:
         if self.communities is not None:
             coarsener.set_communities(self.communities)
 
+        # Preemption tolerance (round 19, resilience/checkpoint.py): the
+        # facade-marked top-level run snapshots its resumable state at
+        # every level boundary (and may itself BE a resumed run).  The
+        # writer's pulls are counted under their own phase with an exact
+        # entitlement asserted below — and asserted ZERO when disarmed.
+        from ..resilience import checkpoint as _ckpt
+        from ..resilience.faults import maybe_inject
+
+        resume = self.resume_state if self._checkpoint_top_level else None
+        sync_pre_cw = sync_stats.phase_count("checkpoint_write")
+        sync_pre_cr = sync_stats.phase_count("checkpoint_restore")
+        ckpt = (
+            _ckpt.writer_for(
+                ctx, self.graph, communities=self.communities,
+                compressed=self.compressed, resume=resume,
+            )
+            if self._checkpoint_top_level else None
+        )
+        if resume is not None:
+            _ckpt.validate_fingerprint(resume, ctx, self.graph)
+            with scoped_timer("checkpoint_restore"):
+                _ckpt.restore_into(coarsener, resume, ctx)
+            # Fast-forward the RNG chain to the boundary's recorded
+            # (seed, draws) position — every draw from here on matches
+            # the uninterrupted run's bit for bit (utils/rng).
+            RandomState.restore(resume.rng_seed, resume.rng_draws)
+
+        def _coarsen_boundary(c):
+            if ckpt is not None:
+                ckpt.on_coarsen_level(c)
+            # Named preemption point (after the write: a kill landing
+            # here finds the boundary's checkpoint already durable).
+            maybe_inject("preempt", site=f"deep_coarsen:{c.num_levels}")
+
         with scoped_timer("partitioning"):
             sync_pre = sync_stats.phase_count("coarsening")
-            coarsest = coarsener.coarsen(k, ctx.partition.epsilon, 2 * C)
+            if resume is not None and resume.stage == "uncoarsening":
+                # The dead run finished coarsening: the restored stack IS
+                # the hierarchy — re-coarsening would double levels.
+                coarsest = coarsener.current_graph
+            else:
+                coarsest = coarsener.coarsen(
+                    k, ctx.partition.epsilon, 2 * C,
+                    on_level=_coarsen_boundary,
+                )
             sync_stats.assert_phase_budget(
                 "coarsening", coarsener.contractions, since=sync_pre
             )
@@ -351,52 +401,78 @@ class DeepMultilevelPartitioner:
                 coarsener.release_input_graph(self.compressed)
                 self.graph = None
                 self._coarsener = coarsener  # rematerialization witness
-            cur_k = min(k, compute_k_for_n(coarsest.n, C, k))
-            Logger.log(
-                f"  deep: coarsest n={coarsest.n} m={coarsest.m} "
-                f"levels={coarsener.num_levels} k0={cur_k}",
-                OutputLevel.DEBUG,
-            )
-
-            rng = RandomState.numpy_rng()
-            if self.communities is not None:
-                # v-cycle: the coarsest partition is the (projected) previous
-                # cycle's partition; extension grows it toward k on the way up.
-                cur_k = self.communities_k
-                part = sync_stats.pull(
-                    coarsener.current_communities,
-                    phase="initial_partitioning",
-                ).astype(np.int32)
-                with scoped_timer("initial_partitioning"):
-                    pass
-            else:
-                budgets = intermediate_block_weights(
-                    np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
+            if resume is not None and resume.stage == "uncoarsening":
+                # Resume at an uncoarsening boundary: the dead run's IP +
+                # refinement up to this level are embodied in the restored
+                # partition — skip straight into the loop (the recorded
+                # RNG position already accounts for their draws).
+                cur_k = resume.cur_k
+                p_graph = PartitionedGraph.create(
+                    coarsener.current_graph, cur_k, resume.partition,
+                    intermediate_block_weights(
+                        np.asarray(
+                            ctx.partition.max_block_weights, dtype=np.int64
+                        ),
+                        cur_k,
+                    ),
+                    ctx.partition.min_block_weights if cur_k == k else None,
                 )
-                sync_pre_ip = sync_stats.phase_count("initial_partitioning")
-                with scoped_timer("initial_partitioning"):
-                    # Orchestration stays host-side (the reference is
-                    # sequential here too), but each bisection's pool runs on
-                    # the ip_backend; every pull lands in this scope.
-                    host = graph_to_host(coarsest)
-                    part = recursive_bipartition(
-                        host, cur_k, budgets, rng, ctx.initial_partitioning
+            else:
+                cur_k = min(k, compute_k_for_n(coarsest.n, C, k))
+                Logger.log(
+                    f"  deep: coarsest n={coarsest.n} m={coarsest.m} "
+                    f"levels={coarsener.num_levels} k0={cur_k}",
+                    OutputLevel.DEBUG,
+                )
+
+                rng = RandomState.numpy_rng()
+                if self.communities is not None:
+                    # v-cycle: the coarsest partition is the (projected) previous
+                    # cycle's partition; extension grows it toward k on the way up.
+                    cur_k = self.communities_k
+                    part = sync_stats.pull(
+                        coarsener.current_communities,
+                        phase="initial_partitioning",
+                    ).astype(np.int32)
+                    with scoped_timer("initial_partitioning"):
+                        pass
+                else:
+                    budgets = intermediate_block_weights(
+                        np.asarray(ctx.partition.max_block_weights, dtype=np.int64), cur_k
                     )
-                if resolve_ip_backend(ctx.initial_partitioning) == "device":
-                    # 1 packed bulk graph pull + <= 1 readback per bisection
-                    # (cur_k - 1 bisections): the device pool's contract.
-                    sync_stats.assert_phase_budget(
-                        "initial_partitioning", max(cur_k, 1), since=sync_pre_ip
-                    )
-            p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
-            p_graph = self._restrict(
-                p_graph, part, cur_k, coarsener.current_communities
-            )
+                    sync_pre_ip = sync_stats.phase_count("initial_partitioning")
+                    with scoped_timer("initial_partitioning"):
+                        # Orchestration stays host-side (the reference is
+                        # sequential here too), but each bisection's pool runs on
+                        # the ip_backend; every pull lands in this scope.
+                        host = graph_to_host(coarsest)
+                        part = recursive_bipartition(
+                            host, cur_k, budgets, rng, ctx.initial_partitioning
+                        )
+                    if resolve_ip_backend(ctx.initial_partitioning) == "device":
+                        # 1 packed bulk graph pull + <= 1 readback per bisection
+                        # (cur_k - 1 bisections): the device pool's contract.
+                        sync_stats.assert_phase_budget(
+                            "initial_partitioning", max(cur_k, 1), since=sync_pre_ip
+                        )
+                p_graph = self._refine(coarsest, part, cur_k, coarsener.num_levels > 0)
+                p_graph = self._restrict(
+                    p_graph, part, cur_k, coarsener.current_communities
+                )
 
             debug = Logger.level.value >= OutputLevel.DEBUG.value
 
             from ..utils import debug as debug_dumps
 
+            # Resume at an uncoarsening boundary re-enters the loop at
+            # the exact state checkpoint B recorded: the first pass over
+            # the boundary point below must NOT re-write (or re-inject) —
+            # it would shift every later boundary's number by one versus
+            # the dead run (flipping the checkpoint_every_levels phase)
+            # and duplicate a snapshot that is already on disk.
+            at_resumed_boundary = (
+                resume is not None and resume.stage == "uncoarsening"
+            )
             sync_pre_cd = sync_stats.phase_count("compressed_decode")
             while True:
                 graph = coarsener.current_graph
@@ -435,6 +511,24 @@ class DeepMultilevelPartitioner:
                             f"{p_graph.edge_cut()}",
                             OutputLevel.DEBUG,
                         )
+                # Level boundary (round 19): extension + refinement for
+                # this level are complete — snapshot the resumable state,
+                # then give the chaos harness its preemption point (a kill
+                # here, or anywhere until the next boundary, resumes
+                # bit-identically from this snapshot).
+                if at_resumed_boundary:
+                    # This boundary IS the restored checkpoint: already
+                    # durable, already numbered — write/inject nothing.
+                    at_resumed_boundary = False
+                else:
+                    if ckpt is not None:
+                        ckpt.on_uncoarsen_boundary(
+                            coarsener, p_graph, cur_k
+                        )
+                    maybe_inject(
+                        "preempt",
+                        site=f"deep_uncoarsen:{coarsener.num_levels}",
+                    )
                 if coarsener.num_levels == 0:
                     break
                 debug_dumps.dump_graph_hierarchy(graph, coarsener.num_levels, ctx)
@@ -464,6 +558,19 @@ class DeepMultilevelPartitioner:
             # sync budget is unchanged by the compressed path.
             sync_stats.assert_phase_budget(
                 "compressed_decode", 0, since=sync_pre_cd
+            )
+            # Checkpoint-write pulls are bounded by the writer's exact
+            # entitlement (5 per newly-cached level [+1 for a device-side
+            # degree histogram] + 1 partition pull per written uncoarsening
+            # boundary) — and ZERO when checkpointing is disarmed; the
+            # restore path performs host->device puts only.
+            sync_stats.assert_phase_budget(
+                "checkpoint_write",
+                ckpt.pull_budget if ckpt is not None else 0,
+                since=sync_pre_cw,
+            )
+            sync_stats.assert_phase_budget(
+                "checkpoint_restore", 0, since=sync_pre_cr
             )
             debug_dumps.dump_partition_hierarchy(p_graph, 0, ctx)
 

@@ -89,6 +89,13 @@ class CircuitBreaker:
         self._consecutive = 0
         self._open_until = 0.0
         self._probe_deadline = 0.0
+        # Atomic probe claim (round 19 satellite): True while the granted
+        # half-open probe has neither reported an outcome nor gone stale.
+        # Concurrent submits racing a cooled-down breaker burn exactly ONE
+        # probe slot — the claim and the open->half-open transition are
+        # one locked step (thread-barrier regression in
+        # tests/test_resilience.py).
+        self._probe_inflight = False
         self.trips = 0
         self.total_failures = 0
         self.total_successes = 0
@@ -103,11 +110,12 @@ class CircuitBreaker:
         """May the primary path be dispatched right now?
 
         closed: yes.  open: no until the cooldown elapses — the first
-        caller after that flips to half-open and gets the ONE probe slot;
-        half-open: no while that probe is in flight.  A probe that never
-        reports back (a caller that cannot observe its own outcome) goes
-        stale after one further cooldown and a new probe is granted — a
-        lost probe must not pin the path demoted forever."""
+        caller after that flips to half-open and atomically CLAIMS the
+        ONE probe slot; half-open: no while that claimed probe is in
+        flight.  A probe that never reports back (a caller that cannot
+        observe its own outcome) goes stale after one further cooldown
+        and a new probe is granted — a lost probe must not pin the path
+        demoted forever."""
         now = time.monotonic() if now is None else now
         with self._lock:
             if self._state == "closed":
@@ -115,27 +123,45 @@ class CircuitBreaker:
             if self._state == "open" and now >= self._open_until:
                 self._state = "half-open"
                 self.probes += 1
+                self._probe_inflight = True
                 self._probe_deadline = now + self.cooldown_s
                 return True
-            if self._state == "half-open" and now >= self._probe_deadline:
-                self.probes += 1
-                self._probe_deadline = now + self.cooldown_s
-                return True
+            if self._state == "half-open":
+                if not self._probe_inflight:
+                    # Half-open without a live claim (an outcome was
+                    # recorded by a path that did not close the breaker):
+                    # grant and claim a fresh probe.
+                    self.probes += 1
+                    self._probe_inflight = True
+                    self._probe_deadline = now + self.cooldown_s
+                    return True
+                if now >= self._probe_deadline:
+                    # Stale claim — the prober vanished; re-claim.
+                    self.probes += 1
+                    self._probe_deadline = now + self.cooldown_s
+                    return True
             return False
 
-    def would_allow(self, now: Optional[float] = None) -> bool:
+    def would_allow(self, now: Optional[float] = None,
+                    claim: bool = False) -> bool:
         """:meth:`allow` as a pure peek — same decision, but never
         consumes the probe slot or mutates state.  Callers that may still
         filter the path out after this check (the fleet router's
         candidate scan) peek first and consume only when the path is
-        actually dispatched."""
+        actually dispatched; ``claim=True`` is that consumption — it is
+        exactly :meth:`allow`, named so call sites read as the
+        peek/claim pair they are."""
         now = time.monotonic() if now is None else now
+        if claim:
+            return self.allow(now)
         with self._lock:
             if self._state == "closed":
                 return True
             if self._state == "open":
                 return now >= self._open_until
-            return now >= self._probe_deadline  # half-open: stale probe
+            # half-open: a fresh probe is only available when no claimed
+            # probe is in flight (or the claim went stale).
+            return (not self._probe_inflight) or now >= self._probe_deadline
 
     def retry_after_s(self, now: Optional[float] = None) -> float:
         now = time.monotonic() if now is None else now
@@ -156,8 +182,20 @@ class CircuitBreaker:
             restored = self._state == "half-open"
             self._state = "closed"
             self._consecutive = 0
+            self._probe_inflight = False
             self.total_successes += 1
             return restored
+
+    def reset(self) -> None:
+        """Administratively close the breaker (round 19: elastic scale-up
+        reviving a RETIRED replica — the trip recorded an intentional
+        drain, not a health verdict, so revival closes it outright rather
+        than spending a half-open probe).  Lifetime counters are kept;
+        only the state machine rewinds."""
+        with self._lock:
+            self._state = "closed"
+            self._consecutive = 0
+            self._probe_inflight = False
 
     def trip(self, now: Optional[float] = None) -> bool:
         """Force-open immediately, regardless of the threshold (the
@@ -170,6 +208,7 @@ class CircuitBreaker:
             opened = self._state != "open"
             self._state = "open"
             self._open_until = now + self.cooldown_s
+            self._probe_inflight = False
             self._consecutive = max(self._consecutive + 1, self.threshold)
             if opened:
                 self.trips += 1
@@ -184,6 +223,7 @@ class CircuitBreaker:
             if self._state == "half-open":
                 self._state = "open"
                 self._open_until = now + self.cooldown_s
+                self._probe_inflight = False
                 self.trips += 1
                 self._consecutive = self.threshold
                 return True

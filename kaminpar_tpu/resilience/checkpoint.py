@@ -1,0 +1,486 @@
+"""Deterministic checkpoint/resume for the deep multilevel pipeline
+(ISSUE 15 tentpole a).
+
+Every run of the deep pipeline is (graph, seed)-deterministic with a
+counter-based RNG chain (``utils/rng``): the key after N draws is a pure
+function of (seed, N).  That is exactly the property that makes
+*bit-identical resume* provable rather than hoped-for — the resumable
+state at a coarsening/uncoarsening **level boundary** is
+
+* the level stack: every coarse level's CSR arrays + its fine->coarse
+  cluster mapping (immutable once contracted, so each level is pulled
+  through counted ``sync_stats.pull`` batches exactly ONCE per run and
+  cached host-side — the ``checkpoint_write`` budget deep.py asserts),
+* the current partition + intermediate ``cur_k`` (uncoarsening stage),
+* the RNG chain position — ``(seed, draws)``, a pair of ints, plus a
+  per-phase draw breakdown for observability,
+* a context fingerprint (graph n/m, k, epsilon, seed, a digest of the
+  result-relevant knob subtrees, git head) that resume validates, and
+* the telemetry censuses at the boundary (record-only).
+
+Checkpoints are written with an **atomic rename** (tmp + fsync +
+``os.replace``), so a kill at any instant leaves either the previous or
+the new checkpoint intact, never a torn file.  Arming:
+``Context.resilience.checkpoint_dir`` or env ``KPTPU_CHECKPOINT``
+(+ ``KPTPU_CHECKPOINT_EVERY``); disarmed, the pipeline performs ZERO
+``checkpoint_write`` pulls (asserted in-pipeline).
+
+Resume: ``KaMinPar.compute_partition(resume=path_or_dir)`` (or ``python
+-m kaminpar_tpu.tools resume``) validates the fingerprint, rebuilds the
+device buffers from the host arrays — same n/m, hence the same
+shape-ladder buckets by construction — restores the RNG chain, and
+continues.  The result is bit-identical to the uninterrupted run,
+asserted across families x buckets x k and for a SIGTERM injected at
+every level boundary (tests/test_checkpoint.py; the ``preempt``
+injection point in :mod:`resilience.faults`).
+
+Envelope: DEEP mode, dense (non-compressed) input, no v-cycle
+communities.  Armed outside it, the pipeline warns once and runs
+un-checkpointed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import warnings
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import sync_stats
+
+_FILE_RE = re.compile(r"^ckpt_deep_b(\d+)\.npz$")
+_VERSION = 1
+
+
+class CheckpointMismatchError(ValueError):
+    """The checkpoint's fingerprint does not match the resuming run —
+    resuming would silently produce a partition of a DIFFERENT problem."""
+
+
+def resolve_dir(resilience) -> Optional[str]:
+    """The armed checkpoint directory: env ``KPTPU_CHECKPOINT`` outranks
+    ``ResilienceContext.checkpoint_dir`` (it reaches child processes);
+    None = disarmed."""
+    path = os.environ.get("KPTPU_CHECKPOINT", "") or getattr(
+        resilience, "checkpoint_dir", ""
+    )
+    return path or None
+
+
+def _every(resilience) -> int:
+    env = os.environ.get("KPTPU_CHECKPOINT_EVERY", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            warnings.warn(
+                f"kaminpar_tpu checkpoint: unparseable "
+                f"KPTPU_CHECKPOINT_EVERY={env!r} ignored",
+                RuntimeWarning,
+            )
+    return max(1, int(getattr(resilience, "checkpoint_every_levels", 1) or 1))
+
+
+def _git_head() -> str:
+    """Current git head, read from files (no subprocess — a checkpoint
+    write must not fork); "" outside a repository."""
+    d = os.getcwd()
+    for _ in range(16):
+        head = os.path.join(d, ".git", "HEAD")
+        if os.path.isfile(head):
+            try:
+                with open(head, encoding="utf-8") as f:
+                    text = f.read().strip()
+                if text.startswith("ref:"):
+                    ref = os.path.join(d, ".git", *text[4:].strip().split("/"))
+                    if os.path.isfile(ref):
+                        with open(ref, encoding="utf-8") as f:
+                            return f.read().strip()
+                    return text
+                return text
+            except OSError:
+                return ""
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return ""
+
+
+def knobs_digest(ctx) -> str:
+    """Digest of the result-relevant knob subtrees.  Excludes the
+    runtime-only trees (parallel/serve/fleet/resilience/debug — none of
+    them changes the computed partition; layout/backends are asserted
+    bit-identical elsewhere) and the partition tree (k/epsilon ride the
+    fingerprint explicitly; block weights derive from them)."""
+    tree = ctx.to_dict()
+    picked = {
+        key: tree.get(key)
+        for key in (
+            "mode", "use_64bit_ids", "vcycles", "restrict_vcycle_refinement",
+            "coarsening", "initial_partitioning", "refinement", "compression",
+        )
+    }
+    blob = json.dumps(picked, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def fingerprint(ctx, graph) -> dict:
+    return {
+        "graph_n": int(graph.n),
+        "graph_m": int(graph.m),
+        "k": int(ctx.partition.k),
+        "epsilon": float(ctx.partition.epsilon),
+        "seed": int(ctx.seed),
+        "mode": str(ctx.mode.value),
+        "use_64bit_ids": bool(ctx.use_64bit_ids),
+        "knobs_digest": knobs_digest(ctx),
+        "preset": str(ctx.preset_name),
+        "git_head": _git_head(),
+    }
+
+
+@dataclass
+class CheckpointState:
+    """One loaded checkpoint (see :func:`load`)."""
+
+    stage: str                      # "coarsening" | "uncoarsening"
+    num_levels: int
+    cur_k: int
+    partition: Optional[np.ndarray]
+    levels: List[dict]              # [{rp, ci, nw, ew, co, meta}, ...]
+    rng_seed: int
+    rng_draws: int
+    contractions: int
+    boundary: int
+    fingerprint: dict
+    meta: dict = field(default_factory=dict)
+    path: str = ""
+
+
+def validate_fingerprint(state: CheckpointState, ctx, graph) -> None:
+    """Raise :class:`CheckpointMismatchError` when the checkpoint was
+    taken from a different (graph, k, epsilon, seed, knobs) problem.
+    A differing git head or preset name is advisory (warned): the knob
+    digest is what actually governs the result."""
+    want = fingerprint(ctx, graph)
+    have = state.fingerprint
+    strict = (
+        "graph_n", "graph_m", "k", "epsilon", "seed", "mode",
+        "use_64bit_ids", "knobs_digest",
+    )
+    diffs = {
+        key: (have.get(key), want[key])
+        for key in strict
+        if have.get(key) != want[key]
+    }
+    if diffs:
+        raise CheckpointMismatchError(
+            "checkpoint fingerprint mismatch (checkpoint vs this run): "
+            + ", ".join(
+                f"{k}={a!r} vs {b!r}" for k, (a, b) in sorted(diffs.items())
+            )
+        )
+    for key in ("git_head", "preset"):
+        if have.get(key) != want[key]:
+            warnings.warn(
+                f"kaminpar_tpu checkpoint: {key} changed since the "
+                f"checkpoint ({have.get(key)!r} -> {want[key]!r}); the "
+                "knob digest matches, so resume proceeds",
+                RuntimeWarning,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+
+class CheckpointWriter:
+    """Level-boundary snapshot writer owned by one deep-pipeline run.
+
+    Coarse levels are immutable once contracted: each level's arrays are
+    pulled exactly ONCE (5 counted pulls under ``checkpoint_write``, +1
+    if its degree histogram lives on device) and cached host-side, so
+    repeated boundary writes re-serialize from the cache.  Uncoarsening
+    boundaries add one partition pull each.  ``pull_budget`` accumulates
+    the writer's exact entitlement — deep.py asserts the phase against
+    it, and against ZERO when no writer is armed."""
+
+    def __init__(self, directory: str, every: int, keep_all: bool,
+                 fp: dict):
+        self.dir = directory
+        self.every = max(1, int(every))
+        self.keep_all = bool(keep_all)
+        self.fingerprint = fp
+        self.boundary = 0
+        self.writes = 0
+        self.pull_budget = 0
+        self._levels: List[dict] = []
+        self._last_path: Optional[str] = None
+        os.makedirs(self.dir, exist_ok=True)
+
+    def seed_from_state(self, state: CheckpointState) -> None:
+        """Resume continuation: inherit the loaded state's host-cached
+        levels (no re-pull) and boundary numbering."""
+        self._levels = [dict(lv) for lv in state.levels]
+        self.boundary = int(state.boundary)
+
+    # -- boundary hooks (called on the pipeline thread) --------------------
+
+    def on_coarsen_level(self, coarsener) -> None:
+        self.boundary += 1
+        if self.boundary % self.every:
+            return
+        self._ensure_levels(coarsener)
+        self._write("coarsening", coarsener, partition=None, cur_k=0)
+
+    def on_uncoarsen_boundary(self, coarsener, p_graph, cur_k: int) -> None:
+        self.boundary += 1
+        if self.boundary % self.every:
+            return
+        self._ensure_levels(coarsener)
+        part = sync_stats.pull(p_graph.partition, phase="checkpoint_write")
+        self.pull_budget += 1
+        self._write(
+            "uncoarsening", coarsener,
+            partition=np.asarray(part, dtype=np.int32), cur_k=int(cur_k),
+        )
+
+    # -- internals ----------------------------------------------------------
+
+    def _ensure_levels(self, coarsener) -> None:
+        hier = coarsener.hierarchy
+        for i in range(len(self._levels), len(hier)):
+            lvl = hier[i]
+            g = lvl.graph
+            rp, ci, nw, ew, co = sync_stats.pull(
+                g.row_ptr, g.col_idx, g.node_w, g.edge_w, lvl.coarse_of,
+                phase="checkpoint_write",
+            )
+            self.pull_budget += 5
+            deg_hist = getattr(g, "_deg_hist", None)
+            if deg_hist is not None and not isinstance(
+                deg_hist, (list, tuple, np.ndarray)
+            ):
+                deg_hist = sync_stats.pull(
+                    deg_hist, phase="checkpoint_write"
+                )
+                self.pull_budget += 1
+            self._levels.append({
+                "rp": np.asarray(rp), "ci": np.asarray(ci),
+                "nw": np.asarray(nw), "ew": np.asarray(ew),
+                "co": np.asarray(co),
+                "meta": {
+                    "n": int(g.n), "m": int(g.m),
+                    "sorted_by_degree": bool(g.sorted_by_degree),
+                    "max_node_weight": _scalar(g, "_max_node_weight"),
+                    "total_edge_weight": _scalar(g, "_total_edge_weight"),
+                    "total_node_weight": _scalar(g, "_total_node_weight"),
+                    "deg_hist": (
+                        None if deg_hist is None
+                        else np.asarray(deg_hist).tolist()
+                    ),
+                },
+            })
+
+    def _write(self, stage: str, coarsener, partition, cur_k: int) -> None:
+        from ..utils.rng import RandomState
+
+        num_levels = coarsener.num_levels
+        seed, draws = RandomState.chain_position()
+        meta = {
+            "version": _VERSION,
+            "stage": stage,
+            "num_levels": int(num_levels),
+            "cur_k": int(cur_k),
+            "boundary": int(self.boundary),
+            "contractions": int(coarsener.contractions),
+            "rng": {
+                "seed": int(seed),
+                "draws": int(draws),
+                "phase_draws": RandomState.phase_draws(),
+            },
+            "fingerprint": self.fingerprint,
+            "levels": [lv["meta"] for lv in self._levels[:num_levels]],
+            "census": _census(),
+        }
+        arrays = {}
+        for i, lv in enumerate(self._levels[:num_levels]):
+            for key in ("rp", "ci", "nw", "ew", "co"):
+                arrays[f"l{i}_{key}"] = lv[key]
+        if partition is not None:
+            arrays["partition"] = partition
+        final = os.path.join(self.dir, f"ckpt_deep_b{self.boundary:04d}.npz")
+        tmp = final + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=np.array(json.dumps(meta)), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        if not self.keep_all and self._last_path and self._last_path != final:
+            try:
+                os.remove(self._last_path)
+            except OSError:
+                pass
+        self._last_path = final
+        self.writes += 1
+
+
+def _scalar(graph, attr) -> Optional[int]:
+    val = getattr(graph, attr, None)
+    return int(val) if isinstance(val, (int, np.integer)) else None
+
+
+def _census() -> dict:
+    """Host-side telemetry totals at the boundary (record-only: resume
+    validates nothing against them — they attribute what the dead run
+    had paid)."""
+    sync = sync_stats.snapshot()
+    out = {
+        "host_sync_count": sync["count"],
+        "host_sync_bytes": sync["bytes"],
+        "implicit": sync["implicit"],
+    }
+    try:
+        from ..utils import compile_stats
+
+        snap = compile_stats.compile_time_snapshot()
+        out["compile_events"] = snap.get("compile_events", 0)
+        out["backend_compile_s"] = round(snap.get("backend_compile_s", 0.0), 3)
+    except Exception:  # noqa: BLE001 — the census must never fail a write
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Load / restore
+# ---------------------------------------------------------------------------
+
+
+def latest(directory: str) -> Optional[str]:
+    """Path of the highest-boundary checkpoint in ``directory``."""
+    best: Optional[tuple] = None
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        match = _FILE_RE.match(name)
+        if match:
+            key = (int(match.group(1)), name)
+            if best is None or key > best:
+                best = key
+    return os.path.join(directory, best[1]) if best else None
+
+
+def load(path: str) -> CheckpointState:
+    """Load a checkpoint file (or the latest one in a directory)."""
+    if os.path.isdir(path):
+        resolved = latest(path)
+        if resolved is None:
+            raise FileNotFoundError(f"no checkpoint files in {path!r}")
+        path = resolved
+    with np.load(path) as npz:
+        meta = json.loads(str(npz["meta"][()]))
+        if meta.get("version") != _VERSION:
+            raise CheckpointMismatchError(
+                f"checkpoint version {meta.get('version')} != {_VERSION}"
+            )
+        levels = []
+        for i, lv_meta in enumerate(meta["levels"]):
+            levels.append({
+                "rp": npz[f"l{i}_rp"], "ci": npz[f"l{i}_ci"],
+                "nw": npz[f"l{i}_nw"], "ew": npz[f"l{i}_ew"],
+                "co": npz[f"l{i}_co"], "meta": lv_meta,
+            })
+        partition = (
+            np.asarray(npz["partition"]) if "partition" in npz.files else None
+        )
+    return CheckpointState(
+        stage=meta["stage"],
+        num_levels=int(meta["num_levels"]),
+        cur_k=int(meta["cur_k"]),
+        partition=partition,
+        levels=levels,
+        rng_seed=int(meta["rng"]["seed"]),
+        rng_draws=int(meta["rng"]["draws"]),
+        contractions=int(meta["contractions"]),
+        boundary=int(meta["boundary"]),
+        fingerprint=meta["fingerprint"],
+        meta=meta,
+        path=path,
+    )
+
+
+def restore_into(coarsener, state: CheckpointState, ctx) -> None:
+    """Rebuild the coarsener's level stack from a loaded checkpoint —
+    host->device puts only (zero blocking pulls, asserted by deep.py
+    under the ``checkpoint_restore`` budget).  The rebuilt coarse graphs
+    land in the SAME shape-ladder buckets as the dead run's (padding is a
+    pure function of n/m), so every downstream kernel shape matches."""
+    import jax.numpy as jnp
+
+    from ..coarsening.cluster_coarsener import CoarseLevel
+    from ..graph.csr import from_numpy_csr
+
+    for lv in state.levels[: state.num_levels]:
+        meta = lv["meta"]
+        g = from_numpy_csr(
+            lv["rp"], lv["ci"], lv["nw"], lv["ew"],
+            use_64bit=bool(ctx.use_64bit_ids),
+        )
+        g._layout_mode = ctx.parallel.device_layout_build
+        g.sorted_by_degree = bool(meta.get("sorted_by_degree", False))
+        for attr in ("max_node_weight", "total_edge_weight",
+                     "total_node_weight"):
+            if meta.get(attr) is not None:
+                setattr(g, f"_{attr}", int(meta[attr]))
+        if meta.get("deg_hist") is not None:
+            g._deg_hist = np.asarray(meta["deg_hist"])
+        coarsener.hierarchy.append(
+            CoarseLevel(g, jnp.asarray(lv["co"]))
+        )
+    coarsener.contractions = int(state.contractions)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline entry
+# ---------------------------------------------------------------------------
+
+_warned_envelope = [False]
+
+
+def writer_for(ctx, graph, communities=None, compressed=None,
+               resume: Optional[CheckpointState] = None
+               ) -> Optional[CheckpointWriter]:
+    """The armed writer of one deep run, or None when disarmed / outside
+    the envelope (dense DEEP input, no communities, no compressed
+    source — warned once when armed outside it)."""
+    directory = resolve_dir(ctx.resilience)
+    if directory is None:
+        return None
+    if graph is None or communities is not None or compressed is not None:
+        if not _warned_envelope[0]:
+            _warned_envelope[0] = True
+            warnings.warn(
+                "kaminpar_tpu checkpoint: armed outside the envelope "
+                "(dense DEEP input, no v-cycle communities, no compressed "
+                "source) — this run proceeds un-checkpointed.",
+                RuntimeWarning,
+            )
+        return None
+    writer = CheckpointWriter(
+        directory,
+        every=_every(ctx.resilience),
+        keep_all=bool(getattr(ctx.resilience, "checkpoint_keep_all", False)),
+        fp=fingerprint(ctx, graph),
+    )
+    if resume is not None:
+        writer.seed_from_state(resume)
+    return writer

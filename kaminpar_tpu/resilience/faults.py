@@ -13,6 +13,11 @@ sanctioned readback (``sync_stats.pull``):
 ``readback``   every counted blocking device->host transfer
 ``queue-admit``  serve admission, before the request is queued
 ``warmup``     the engine warmup pass entry
+``preempt``    deep-pipeline level boundaries (round 19): a firing spec
+               SIGTERMs the process itself instead of raising — the
+               checkpoint/resume kill-matrix's deterministic preemption
+               (the boundary's checkpoint is already durable when the
+               kill lands; tests drive it through a subprocess harness)
 =============  ==========================================================
 
 A *fault plan* is a comma-separated list of specs::
@@ -54,7 +59,9 @@ from typing import Dict, List, Optional
 
 from .errors import FAILURE_CLASSES, ResilienceError
 
-INJECTION_POINTS = ("compile", "execute", "readback", "queue-admit", "warmup")
+INJECTION_POINTS = (
+    "compile", "execute", "readback", "queue-admit", "warmup", "preempt",
+)
 
 
 @dataclass
@@ -85,6 +92,12 @@ class FaultSpec:
             )
         if not 0.0 <= self.p <= 1.0:
             raise ValueError(f"p={self.p} outside [0, 1]")
+        if self.count < 0:
+            raise ValueError(f"n={self.count} must be >= 0")
+        if self.after < 0:
+            raise ValueError(f"after={self.after} must be >= 0")
+        if self.delay_s < 0:
+            raise ValueError(f"delay={self.delay_s} must be >= 0")
         return self
 
 
@@ -98,7 +111,16 @@ class FaultPlan:
 
     @classmethod
     def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """Parse a plan string; malformed plans raise a typed
+        :class:`ValueError` naming the offending spec at ARM time —
+        silent partial arming (round-19 satellite) would let a chaos
+        run claim coverage its plan never delivered.  Rejected: unknown
+        point/error/key names, non-numeric or negative ``n=``/``after=``/
+        ``p=``/``delay=`` values, and duplicate (point, site, error)
+        specs (the second copy would be unreachable: the first matching
+        spec wins every hit)."""
         specs: List[FaultSpec] = []
+        seen: set = set()
         for raw in text.split(","):
             raw = raw.strip()
             if not raw:
@@ -111,17 +133,45 @@ class FaultPlan:
             for kv in parts[2:]:
                 key, _, val = kv.partition("=")
                 key = key.strip()
-                if key == "n":
-                    spec.count = int(val)
-                elif key == "after":
-                    spec.after = int(val)
-                elif key == "p":
-                    spec.p = float(val)
-                elif key == "delay":
-                    spec.delay_s = float(val)
-                else:
-                    raise ValueError(f"unknown fault-spec key {key!r} in {raw!r}")
-            specs.append(spec.validate())
+                try:
+                    if key == "n":
+                        spec.count = int(val)
+                    elif key == "after":
+                        spec.after = int(val)
+                    elif key == "p":
+                        spec.p = float(val)
+                    elif key == "delay":
+                        spec.delay_s = float(val)
+                    else:
+                        raise ValueError(
+                            f"unknown fault-spec key {key!r} in {raw!r}"
+                        )
+                except ValueError as exc:
+                    if "fault-spec key" in str(exc):
+                        raise
+                    raise ValueError(
+                        f"malformed {key}= value {val!r} in fault spec "
+                        f"{raw!r}"
+                    ) from None
+            try:
+                spec.validate()
+            except ValueError as exc:
+                raise ValueError(f"{exc} (in fault spec {raw!r})") from None
+            # Duplicate = FULLY identical spec (point, site, error AND
+            # all firing parameters).  Same-(point, site, error) specs
+            # with different n=/after=/p= are legal STAGED plans — the
+            # matcher falls through exhausted or after-gated specs, so
+            # "fire at hit 1 and again at hit 11" is two specs on
+            # purpose; only an exact copy is redundant by construction.
+            ident = (spec.point, spec.site, spec.error, spec.count,
+                     spec.after, spec.p, spec.delay_s)
+            if ident in seen:
+                raise ValueError(
+                    f"duplicate fault spec {raw!r} — an identical copy "
+                    "is already in the plan and could never add a firing"
+                )
+            seen.add(ident)
+            specs.append(spec)
         return cls(specs=specs, seed=int(seed), source=text)
 
 
@@ -249,6 +299,19 @@ def maybe_inject(point: str, site: str = "") -> None:
         return
     if fire.delay_s > 0:
         time.sleep(fire.delay_s)
+    if fire.point == "preempt":
+        # Preemption is a process death, not an exception: SIGTERM
+        # ourselves (the default handler terminates), exactly what a
+        # preempted TPU worker receives.  The kill-matrix subprocess
+        # harness observes the child die and resumes from its checkpoint
+        # (resilience/checkpoint.py); the spec's error class is unused.
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Signal delivery happens on the main thread between bytecodes;
+        # from a worker thread, give it a beat rather than racing on.
+        time.sleep(5.0)
+        return
     err_cls = FAILURE_CLASSES[fire.error]
     raise _construct(err_cls, fire, point, site)
 

@@ -76,13 +76,26 @@ class ServeFuture:
         self._error: Optional[BaseException] = None
         self._cancelled = False
         self._started = False
+        # First-wins claim, distinct from the waiter event (round 19):
+        # the finalization hook must run BETWEEN claiming the outcome and
+        # releasing the waiter — a caller must never act on a result the
+        # journal has not recorded — so _done claims under the lock,
+        # _on_done fires outside it, and only then does _ev wake waiters.
+        self._done = False
         self._lock = threading.Lock()
+        # Finalization hook (round 19, serve/journal.py): invoked exactly
+        # once — on the FIRST-WINS resolution/rejection, outside the lock
+        # but BEFORE the waiter event — with (result | None, error |
+        # None).  The engine points it at the journal's resolution
+        # writer, so every terminal path (dispatcher, watchdog, deadline,
+        # drain) journals through one funnel.
+        self._on_done = None
 
     def cancel(self) -> bool:
         """Cancel if execution has not started; returns success.  A running
         XLA computation cannot be interrupted — late cancels return False."""
         with self._lock:
-            if self._started or self._ev.is_set():
+            if self._started or self._done:
                 return False
             self._cancelled = True
         return True
@@ -105,21 +118,38 @@ class ServeFuture:
         """First resolution wins (round 17): the execution watchdog may
         force-reject a hung batch's futures from its monitor thread; if
         the abandoned dispatch later returns, its late result is
-        discarded here.  Returns whether THIS call resolved the future."""
+        discarded here.  Returns whether THIS call resolved the future.
+
+        The finalization hook fires BEFORE the waiter event (round 19):
+        a journaled resolution must be durable before ``result()`` can
+        return it (serve/journal.py durability contract)."""
         with self._lock:
-            if self._ev.is_set():
+            if self._done:
                 return False
+            self._done = True
             self._result = result
-            self._ev.set()
-            return True
+        self._fire_on_done(result, None)
+        self._ev.set()
+        return True
 
     def _reject(self, error: BaseException) -> bool:
         with self._lock:
-            if self._ev.is_set():
+            if self._done:
                 return False
+            self._done = True
             self._error = error
-            self._ev.set()
-            return True
+        self._fire_on_done(None, error)
+        self._ev.set()
+        return True
+
+    def _fire_on_done(self, result, error) -> None:
+        cb = self._on_done
+        if cb is None:
+            return
+        try:
+            cb(result, error)
+        except Exception:  # noqa: BLE001 — a journaling failure must never
+            pass           # un-resolve a finished request
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -292,6 +322,23 @@ class PartitionEngine:
         # allocator stats).
         self._capacity_ceiling: Optional[int] = None
         self._device_kind: str = ""
+        # Crash-safe journal (round 19, serve/journal.py): admitted
+        # requests are journaled at admit and at first-wins resolution;
+        # start() replays unresolved entries + restores the warm state.
+        # Env KPTPU_SERVE_JOURNAL overrides (reaches child processes).
+        import os as _os
+
+        env_journal = _os.environ.get("KPTPU_SERVE_JOURNAL", "")
+        if env_journal and self.name:
+            # A fleet's replicas all see the same env var: suffix by the
+            # engine name or N engines would interleave one journal file
+            # with colliding request ids (the context-knob path gets its
+            # per-replica suffix from the fleet constructor).
+            env_journal += f".{self.name}"
+        self._journal_path = env_journal or getattr(
+            self.serve, "journal_path", ""
+        )
+        self._journal = None
         self._ids = itertools.count(1)
         self._solver = None
         self._thread: Optional[threading.Thread] = None
@@ -352,6 +399,23 @@ class PartitionEngine:
                         RuntimeWarning,
                         stacklevel=2,
                     )
+            recovery = None
+            if self._journal_path and self._journal is None:
+                # Crash recovery (round 19, serve/journal.py): parse the
+                # journal BEFORE warmup — the warm-state record seeds the
+                # warm sets through the PR 14 inheritance path, so
+                # warmup below raises zero compile events for restored
+                # cells; unresolved admits replay once the queue exists.
+                from . import journal as _journal
+
+                recovery = _journal.read_journal(self._journal_path)
+                if recovery["max_id"]:
+                    # Resume the id counter past the dead run's ids so a
+                    # fresh admission can never collide with a journal
+                    # entry awaiting replay.
+                    self._ids = itertools.count(recovery["max_id"] + 1)
+                if recovery["warm_state"] is not None:
+                    _journal.apply_warm_state(self, recovery["warm_state"])
             try:
                 self._resolve_capacity_ceiling()
                 if warmup:
@@ -362,6 +426,22 @@ class PartitionEngine:
                 # for a never-running engine).
                 self._disarm_faults()
                 raise
+            if recovery is not None:
+                from ..utils.timer import scoped_timer
+                from . import journal as _journal
+
+                self._journal = _journal.ServeJournal(
+                    self._journal_path,
+                    fsync_every=self.serve.journal_fsync_every,
+                )
+                # Durable warm state as of THIS start (first runs write
+                # their fresh warmup here; restarts refresh the record).
+                self._journal.append(
+                    _journal.warm_state_record(self), force_fsync=True
+                )
+                if recovery["unresolved"]:
+                    with scoped_timer("journal_replay"):
+                        self._replay_journal(recovery["unresolved"])
             self._running = True
             thread_name = "kaminpar-serve-dispatch" + (
                 f"-{self.name}" if self.name else ""
@@ -900,6 +980,11 @@ class PartitionEngine:
                         )
                 if hung:
                     self.stats_.bump("worker_hung", hung)
+        # Final warm-state record + journal close (fsynced): a clean
+        # shutdown leaves zero unresolved entries — EngineStopped/
+        # WorkerHung force-resolutions above deliberately stay
+        # UNRESOLVED in the journal so a restart replays them.
+        self._close_journal()
         self._disarm_faults()
         with self._lock:
             self._running = False
@@ -911,6 +996,162 @@ class PartitionEngine:
 
             faults.disarm()
             self._armed_faults = False
+
+    # -- crash-safe journal (round 19, serve/journal.py) -------------------
+
+    def _journal_admit(self, req: ServeRequest) -> None:
+        """Journal one accepted request (admit record: params + graph
+        payload, ONE counted bulk pull under ``journal_write``).  The
+        future's resolution hook is installed by the submit path BEFORE
+        the queue insert — a dispatcher racing ahead of this append just
+        writes the resolve record first, which read_journal tolerates."""
+        from ..utils.timer import scoped_timer
+        from . import journal as _journal
+
+        with scoped_timer("journal_write"):
+            record = {
+                "t": "admit",
+                "id": req.id,
+                "k": req.k,
+                "epsilon": req.epsilon,
+                "quality": req.quality,
+                "min_epsilon": req.min_epsilon,
+                "max_block_weights": (
+                    None if req.max_block_weights is None
+                    else [int(x) for x in req.max_block_weights]
+                ),
+                "min_block_weights": (
+                    None if req.min_block_weights is None
+                    else [int(x) for x in req.min_block_weights]
+                ),
+                "graph": _journal.encode_graph(req.graph),
+            }
+            self._journal.append(record)
+
+    def _journal_resolution(self, jid: int, result, error) -> None:
+        """Append the terminal record of journal entry ``jid`` — except
+        for "the engine gave it back" classes (EngineStoppedError /
+        WorkerHung), which leave the entry unresolved so a restart
+        replays it (losing accepted work is the one thing the journal
+        exists to prevent)."""
+        jr = self._journal
+        if jr is None:
+            return
+        if error is not None:
+            from ..resilience.errors import WorkerHung
+
+            if isinstance(error, (EngineStoppedError, WorkerHung)):
+                return
+            record = {
+                "t": "resolve", "id": jid, "ok": 0,
+                "error": getattr(
+                    error, "failure_class", type(error).__name__
+                ),
+            }
+        else:
+            record = {
+                "t": "resolve", "id": jid, "ok": 1,
+                "cut": int(result.cut), "feasible": int(result.feasible),
+            }
+        jr.append(record, force_fsync=True)
+        self.stats_.bump("journal_resolutions")
+
+    def _replay_journal(self, entries) -> None:
+        """Re-enqueue the journal's unresolved admits idempotently: each
+        replayed request keeps its ORIGINAL journal id for the resolution
+        record (no second admit record is written), runs without a
+        deadline (the original deadline died with its process), and
+        bypasses the admission bound — the work was admitted once
+        already.  Decode is host->device puts only (zero pulls)."""
+        from . import journal as _journal
+
+        now = time.monotonic()
+        for entry in entries:
+            try:
+                graph = _journal.decode_graph(
+                    entry["graph"],
+                    use_64bit=bool(self.ctx.use_64bit_ids),
+                    layout_mode=self.ctx.parallel.device_layout_build,
+                )
+            except (KeyError, ValueError) as exc:
+                import warnings
+
+                warnings.warn(
+                    f"kaminpar_tpu serve: journal entry {entry.get('id')} "
+                    f"unreplayable ({type(exc).__name__}: {exc}) — skipped",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            cell = shape_cell(graph, int(entry["k"]))
+            quality = str(entry.get("quality", "strong"))
+            req = ServeRequest(
+                id=next(self._ids),
+                graph=graph,
+                k=int(entry["k"]),
+                epsilon=float(entry["epsilon"]),
+                cell=cell,
+                future=ServeFuture(0),
+                enqueue_t=now,
+                deadline_t=None,
+                warm_hit=(cell.n_bucket, int(entry["k"]), quality)
+                in self._warm_nk,
+                max_block_weights=entry.get("max_block_weights"),
+                min_epsilon=float(entry.get("min_epsilon", 0.0) or 0.0),
+                min_block_weights=entry.get("min_block_weights"),
+                quality=quality,
+            )
+            req.future.request_id = req.id
+            req.future._on_done = (
+                lambda result, error, _id=int(entry["id"]):
+                    self._journal_resolution(_id, result, error)
+            )
+            self.stats_.record_warm(req.warm_hit)
+            self._queue.put(req, force=True)
+            self.stats_.bump("journal_replayed")
+
+    def journal_mark_resteered(self, request_id: int) -> None:
+        """Resolve journal entry ``request_id`` as re-homed (round 19):
+        the fleet drain successfully requeued this request on a sibling
+        replica, whose own journal now owns it — leaving the entry
+        unresolved here would make a later revival of this slot replay
+        work that already completed elsewhere."""
+        jr = self._journal
+        if jr is None:
+            return
+        jr.append(
+            {"t": "resolve", "id": int(request_id), "ok": 0,
+             "error": "resteered"},
+            force_fsync=True,
+        )
+        self.stats_.bump("journal_resolutions")
+
+    def _close_journal(self) -> None:
+        jr = self._journal
+        if jr is None:
+            return
+        from . import journal as _journal
+
+        try:
+            jr.append(_journal.warm_state_record(self), force_fsync=True)
+        finally:
+            jr.close()
+            self._journal = None
+        try:
+            # Clean shutdown compacts the history down to what recovery
+            # needs (unresolved admits + the final warm state): an
+            # append-only file would otherwise grow one graph payload
+            # per request forever and tax every restart's parse.
+            _journal.compact(jr.path)
+        except OSError as exc:
+            import warnings
+
+            warnings.warn(
+                f"kaminpar_tpu serve: journal compaction failed "
+                f"({exc}); the full history remains valid",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def __enter__(self) -> "PartitionEngine":
         return self.start()
@@ -993,9 +1234,23 @@ class PartitionEngine:
         from ..telemetry import trace as ttrace
 
         rec = ttrace.active()
+        if self._journal is not None:
+            # Install the resolution funnel BEFORE the queue insert: the
+            # dispatcher may resolve the request the instant it is
+            # queued, and a first-wins finalization racing ahead of the
+            # hook would leave the entry unresolved forever (replayed as
+            # duplicate work on every restart).  A resolve record landing
+            # before its admit record is fine — read_journal matches by
+            # id, not by order.
+            req.future._on_done = (
+                lambda result, error, _id=req.id:
+                    self._journal_resolution(_id, result, error)
+            )
         try:
             self._queue.put(req)
         except QueueFullError:
+            if self._journal is not None:
+                req.future._on_done = None  # never admitted: nothing to log
             self.stats_.bump("rejected_full")
             retry_after = self.stats_.retry_after_estimate(
                 len(self._queue), self.serve.max_batch
@@ -1005,6 +1260,11 @@ class PartitionEngine:
                             retry_after_s=round(retry_after, 3))
             raise QueueFullError(retry_after) from None
         self.stats_.bump("admitted")
+        if self._journal is not None:
+            # Admitted => journaled: from here on, the only ways out of
+            # the journal are a resolution record or a replay after
+            # restart (serve/journal.py).
+            self._journal_admit(req)
         if rec is not None:
             # Queue lifecycle point: admission (the matching dispatch/resolve
             # events come from the dispatcher thread's batch span).
@@ -1549,6 +1809,11 @@ class PartitionEngine:
             "watchdog": self.watchdog.snapshot(),
             "faults": rfaults.snapshot(),
         }
+        # Crash-safe journal surface (round 19, serve/journal.py):
+        # append/fsync counts of the live journal file — the replay and
+        # resolution counters ride the standard counter block above.
+        if self._journal is not None:
+            snap["journal"] = self._journal.snapshot()
         return snap
 
     def metrics_text(self) -> str:

@@ -33,6 +33,17 @@ and the capacity-preflight verdict — instead of a single EMA:
   first-wins finalization).  The fleet-scoped ``replica`` breaker rung
   restores a drained replica through the standard half-open probe.
 
+* **elastic scaling** (round 19) — :meth:`PartitionFleet.scale_to`
+  resizes the fleet under live traffic: scale-up revives retired slots
+  (warm state carries over) before spawning fresh inheriting replicas;
+  scale-down retires the highest-index replicas through the drain/
+  resteer machinery with conserved resolutions.  An optional
+  ``autoscale`` policy (queue-drain watermarks with hysteresis, driven
+  from the submit-path health sweep) sizes the fleet automatically, and
+  a replica the health sweep takes out is *replaced*, not just drained
+  (``replace_drained``).  Census in ``stats()`` ``fleet_scale_*``
+  counters / ``kaminpar_fleet_scale_total``.
+
 CPU-dryrun honesty: virtual host devices SERIALIZE — a CPU fleet number
 is a router/occupancy claim, not a parallel-speedup claim; the
 device-axis throughput claim rides tpu_prober (TPU_NOTES round 18).
@@ -223,12 +234,19 @@ class PartitionFleet:
         from ..context import _resolve_cache_settings
 
         cache_enabled, cache_dir = _resolve_cache_settings(ctx.parallel)
+        self._cache_enabled, self._cache_dir = cache_enabled, cache_dir
+        self._serve_overrides = dict(serve_overrides)
         self.replicas: List[PartitionEngine] = []
         for i in range(n):
             rctx = copy.deepcopy(ctx)
             rctx.parallel.placement_device = i
             if cache_enabled and cache_dir:
                 rctx.parallel.compilation_cache_dir = cache_dir
+            if rctx.serve.journal_path:
+                # Per-replica journal files (round 19): one shared path
+                # would interleave N engines' records with colliding
+                # request ids, making replay ambiguous.
+                rctx.serve.journal_path += f".replica{i}"
             self.replicas.append(
                 PartitionEngine(rctx, name=f"replica{i}", **serve_overrides)
             )
@@ -243,6 +261,11 @@ class PartitionFleet:
         self._draining = [False] * n
         self._drain_threads: List[Optional[threading.Thread]] = [None] * n
         self._watchdog_seen = [0] * n
+        # Elastic membership (round 19): a RETIRED slot was scaled down
+        # on purpose — unroutable, NOT probe-restorable (an intentional
+        # drain is not a health verdict) — and is revived cheaply by the
+        # next scale-up (warm state carries over engine restarts).
+        self._retired = [False] * n
         self._sticky: Dict[object, int] = {}
         self._records: Dict[int, _FleetRecord] = {}  # id(engine future) ->
         self._counters: Dict[str, int] = {
@@ -251,7 +274,27 @@ class PartitionFleet:
             "rejected_full": 0, "rejected_unroutable": 0,
             "rejected_capacity": 0,
             "steer_retries": 0, "probe_steers": 0,
+            # Elastic-scaling census (round 19, ISSUE 15): scale_to
+            # calls by direction, how each slot changed (fresh spawn vs
+            # retired-slot revival vs retirement), health-sweep
+            # replacements, and autoscale decisions.
+            "fleet_scale_ups": 0, "fleet_scale_downs": 0,
+            "fleet_scale_spawns": 0, "fleet_scale_revives": 0,
+            "fleet_scale_retires": 0, "fleet_scale_replacements": 0,
+            "fleet_scale_auto_ups": 0, "fleet_scale_auto_downs": 0,
         }
+        # Autoscale hysteresis state: consecutive health sweeps above the
+        # high / below the low queue-drain watermark.
+        self._above_high = 0
+        self._below_low = 0
+        self._scale_lock = threading.Lock()
+        # Sweep-triggered scaling (autoscale / replacement) runs on this
+        # single background thread — spawning + warming a replica can
+        # take seconds and must never block the submit path that happened
+        # to run the health sweep.  One action at a time: a pending
+        # action absorbs further triggers (the next sweep re-evaluates).
+        self._bg_scale: Optional[threading.Thread] = None
+        self._warmup_flag = True
         # Submit-path health-check throttle: the auto-drain sweep reads
         # every replica's signals — once per interval, not per request.
         self._health_interval_s = 0.05
@@ -291,6 +334,9 @@ class PartitionFleet:
                 return self
             self._started = True
             self._stopping = False
+            # Remembered for elastic scale-up: a spawned replica starts
+            # the way the fleet itself was started.
+            self._warmup_flag = bool(warmup)
         first = self.replicas[0]
         first.start(warmup=warmup)
         for eng in self.replicas[1:]:
@@ -315,6 +361,9 @@ class PartitionFleet:
             if not self._started:
                 return
             self._stopping = True
+            bg = self._bg_scale
+        if bg is not None:
+            bg.join(self.fleet_ctx.drain_timeout_s)
         for t in self._drain_threads:
             if t is not None:
                 t.join(self.fleet_ctx.drain_timeout_s)
@@ -354,6 +403,10 @@ class PartitionFleet:
         peeks first so its cell-breaker/capacity filters cannot burn a
         probe on a replica they then drop."""
         if self._stopping:
+            return False, False
+        if self._retired[idx]:
+            # Scaled down on purpose: not a health failure, so no probe
+            # traffic — only scale_to revives a retired slot.
             return False, False
         br = self.breakers.get("replica", (idx,))
         if br.state == "closed":
@@ -483,33 +536,312 @@ class PartitionFleet:
         monitor thread; a fleet with no traffic has nothing to steer).
         Throttled to one sweep per ``_health_interval_s`` so a burst does
         not pay the per-replica signal reads per request."""
-        if not self.fleet_ctx.auto_drain:
-            return
         now = time.monotonic()
         if now - self._last_health_check < self._health_interval_s:
             return
         self._last_health_check = now
-        for idx, eng in enumerate(self.replicas):
-            if self._draining[idx] or not eng.running:
-                continue
-            sig = eng.steer_signals()
-            if sig["watchdog_timeouts"] < self._watchdog_seen[idx]:
-                # The engine's stats were reset under us (bench windows
-                # do): re-anchor the watermark or real fires after the
-                # reset would be silently swallowed by the stale delta.
-                self._watchdog_seen[idx] = sig["watchdog_timeouts"]
-            fired = sig["watchdog_timeouts"] - self._watchdog_seen[idx]
-            open_cells = sig["open_cell_breakers"]
-            if fired > 0 or (
-                self.fleet_ctx.auto_drain_open_cells > 0
-                and open_cells >= self.fleet_ctx.auto_drain_open_cells
-            ):
-                self._watchdog_seen[idx] = sig["watchdog_timeouts"]
-                reason = (
-                    f"watchdog fired {fired}x" if fired > 0
-                    else f"{open_cells} cell breakers latched open"
+        if self.fleet_ctx.auto_drain:
+            # A health drain REPLACES the replica (round 19) when the
+            # fleet is configured elastic: capacity must not dip for the
+            # drain cooldown, so a fresh replica (inheriting the fleet's
+            # warm state) takes the retired slot's place immediately.
+            replace = (
+                self.fleet_ctx.replace_drained or self.fleet_ctx.autoscale
+            )
+            for idx, eng in enumerate(self.replicas):
+                if self._draining[idx] or not eng.running:
+                    continue
+                sig = eng.steer_signals()
+                if sig["watchdog_timeouts"] < self._watchdog_seen[idx]:
+                    # The engine's stats were reset under us (bench
+                    # windows do): re-anchor the watermark or real fires
+                    # after the reset would be silently swallowed by the
+                    # stale delta.
+                    self._watchdog_seen[idx] = sig["watchdog_timeouts"]
+                fired = sig["watchdog_timeouts"] - self._watchdog_seen[idx]
+                open_cells = sig["open_cell_breakers"]
+                if fired > 0 or (
+                    self.fleet_ctx.auto_drain_open_cells > 0
+                    and open_cells >= self.fleet_ctx.auto_drain_open_cells
+                ):
+                    self._watchdog_seen[idx] = sig["watchdog_timeouts"]
+                    reason = (
+                        f"watchdog fired {fired}x" if fired > 0
+                        else f"{open_cells} cell breakers latched open"
+                    )
+                    self.drain_replica(idx, reason=reason, retire=replace)
+                    if replace:
+                        self._replace_replica(idx, reason)
+        self._autoscale_sweep()
+
+    # -- elastic scaling (round 19, ISSUE 15) ------------------------------
+
+    def _active_indices(self) -> List[int]:
+        """Slots participating in the fleet's target size (everything not
+        retired — a health-drained-but-not-retired replica still counts:
+        it is expected back through the half-open probe)."""
+        return [
+            i for i in range(len(self.replicas)) if not self._retired[i]
+        ]
+
+    @property
+    def active_replicas(self) -> int:
+        return len(self._active_indices())
+
+    def scale_to(self, n: int, reason: str = "") -> dict:
+        """Elastically resize the fleet to ``n`` active replicas UNDER
+        LIVE TRAFFIC (round 19 tentpole c).
+
+        Scale-up first revives retired slots in index order (the engine
+        object is kept across retirement, so its warm state — solver
+        caches, warm cells, stats — carries over for free), then spawns
+        fresh replicas that inherit the fleet's warm state + shared
+        persistent cache dir (zero compile-event warmup delta, the PR 14
+        inheritance argument) and journal nothing until started.
+        Scale-down retires the highest-index active replicas through the
+        PR 14 drain/resteer machinery — queued work requeues eagerly on
+        the survivors, in-flight work finishes or is force-resolved typed
+        and resteered lazily, so resolutions are conserved (zero lost,
+        zero duplicated — asserted under an 8-thread live burst in
+        tests/test_elastic.py) and sticky tenants re-home on their next
+        request (counted in ``sticky_moves``).
+
+        Returns an action summary ``{target, active, spawned, revived,
+        retired}``.  Serialized against concurrent scaling; never goes
+        below one active replica."""
+        n = max(1, int(n))
+        if not self._started or self._stopping:
+            raise EngineStoppedError("fleet not started (call start())")
+        with self._scale_lock:
+            active = self._active_indices()
+            delta = n - len(active)
+            actions: dict = {
+                "target": n, "spawned": [], "revived": [], "retired": [],
+            }
+            if delta > 0:
+                with self._lock:
+                    self._counters["fleet_scale_ups"] += 1
+                for _ in range(delta):
+                    revived = None
+                    for i in range(len(self.replicas)):
+                        if self._retired[i] and self._revive_replica(i):
+                            revived = i
+                            break
+                    if revived is not None:
+                        actions["revived"].append(revived)
+                        with self._lock:
+                            self._counters["fleet_scale_revives"] += 1
+                    else:
+                        # No retired slot (or every candidate's drain is
+                        # still wedged in flight): spawn fresh.
+                        actions["spawned"].append(self._spawn_replica())
+                        with self._lock:
+                            self._counters["fleet_scale_spawns"] += 1
+            elif delta < 0:
+                with self._lock:
+                    self._counters["fleet_scale_downs"] += 1
+                for idx in sorted(active, reverse=True)[:-delta]:
+                    self.drain_replica(
+                        idx,
+                        reason=reason or f"scale_to({n})",
+                        retire=True,
+                    )
+                    actions["retired"].append(idx)
+                    with self._lock:
+                        self._counters["fleet_scale_retires"] += 1
+            actions["active"] = self.active_replicas
+        from ..telemetry import trace as ttrace
+
+        trec = ttrace.active()
+        if trec is not None:
+            trec.instant("fleet.scale", target=n, reason=reason,
+                         spawned=len(actions["spawned"]),
+                         revived=len(actions["revived"]),
+                         retired=len(actions["retired"]))
+        return actions
+
+    def _spawn_replica(self) -> int:
+        """Construct + start one fresh replica at the next index (caller
+        holds ``_scale_lock``): same deepcopied base context, device
+        placement wrapping the mesh, the fleet's shared persistent cache
+        dir, and warm-state inheritance from the first healthy replica —
+        it joins the routable set only once started (``running`` gates
+        ``_replica_available``), and journals nothing until then."""
+        idx = len(self.replicas)
+        try:
+            import jax
+
+            n_dev = max(1, len(jax.devices()))
+        except Exception:  # noqa: BLE001 — placement is locality only
+            n_dev = 1
+        rctx = copy.deepcopy(self.ctx)
+        rctx.parallel.placement_device = idx % n_dev
+        if self._cache_enabled and self._cache_dir:
+            rctx.parallel.compilation_cache_dir = self._cache_dir
+        if rctx.serve.journal_path:
+            rctx.serve.journal_path += f".replica{idx}"
+        eng = PartitionEngine(
+            rctx, name=f"replica{idx}", **self._serve_overrides
+        )
+        donor = next(
+            (
+                self.replicas[i] for i in self._active_indices()
+                if self.replicas[i].running and not self._draining[i]
+            ),
+            None,
+        )
+        if donor is not None and self.fleet_ctx.inherit_warm_cache:
+            eng.inherit_warmup(donor)
+        eng.start(warmup=self._warmup_flag)
+        # State arrays grow BEFORE the replicas list: every reader
+        # indexes arrays by a position < len(self.replicas).
+        with self._lock:
+            self._draining.append(False)
+            self._drain_threads.append(None)
+            self._watchdog_seen.append(0)
+            self._steered.append(0)
+            self._retired.append(False)
+        self.replicas.append(eng)
+        return idx
+
+    def _revive_replica(self, idx: int) -> bool:
+        """Bring a retired slot back into rotation (caller holds
+        ``_scale_lock``): join any straggling drain, restart the kept
+        engine (warm state carries over restarts — no warmup pass), and
+        administratively close its fleet breaker (the trip recorded an
+        intentional retirement, not a health verdict).
+
+        Returns False — slot NOT revived — when the drain thread is
+        still alive after the join budget: its eventual ``shutdown``
+        would stop the engine right after we marked it active, leaving a
+        phantom slot that counts toward capacity but routes nothing.
+        The caller spawns a fresh replica instead."""
+        t = self._drain_threads[idx]
+        if t is not None:
+            t.join(self.fleet_ctx.drain_timeout_s)
+            if t.is_alive():
+                return False
+            self._drain_threads[idx] = None
+        eng = self.replicas[idx]
+        if not eng.running:
+            eng.start(warmup=False)
+        with self._lock:
+            self._retired[idx] = False
+            self._draining[idx] = False
+        self.breakers.get("replica", (idx,)).reset()
+        return True
+
+    def _scale_in_background(self, fn, label: str) -> None:
+        """Run one sweep-triggered scaling action detached: replica
+        spawn + warmup can take seconds, and the health sweep runs on a
+        client's submit thread.  At most one action is in flight; extra
+        triggers are absorbed (the next sweep re-evaluates the signal)."""
+        with self._lock:
+            if self._bg_scale is not None and self._bg_scale.is_alive():
+                return
+            thread = threading.Thread(
+                target=self._run_bg_scale, args=(fn,),
+                name=f"kaminpar-fleet-scale-{label}", daemon=True,
+            )
+            self._bg_scale = thread
+        thread.start()
+
+    def _run_bg_scale(self, fn) -> None:
+        try:
+            fn()
+        except EngineStoppedError:
+            pass  # fleet shut down under the action: nothing to scale
+        except Exception as exc:  # noqa: BLE001 — a failed background
+            # scale must be loud, not a silently dead thread.
+            warnings.warn(
+                f"kaminpar_tpu fleet: background scaling failed "
+                f"({type(exc).__name__}: {exc})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    def _replace_replica(self, idx: int, reason: str) -> None:
+        """Health-sweep replacement: the watchdog/breaker drain retired
+        slot ``idx``; spawn a FRESH substitute (never revive the sick
+        slot — reviving would restart the engine the watchdog just
+        condemned, while its drain is still in flight) so active
+        capacity does not dip for the drain cooldown.  The spawn runs
+        detached (see :meth:`_scale_in_background`)."""
+        if self._stopping:
+            return
+        with self._lock:
+            self._counters["fleet_scale_replacements"] += 1
+
+        def _spawn():
+            if self._stopping:
+                return
+            with self._scale_lock:
+                self._spawn_replica()
+                with self._lock:
+                    self._counters["fleet_scale_spawns"] += 1
+
+        self._scale_in_background(_spawn, f"replace{idx}")
+
+    def _autoscale_sweep(self) -> None:
+        """Watermark autoscaler (round 19): driven from the same
+        submit-path health sweep as auto-drain — the mean per-replica
+        queue-drain estimate (depth x unamortized EMA / max_batch, the
+        PR 6 rule) crossing ``autoscale_high_s`` for
+        ``autoscale_hysteresis`` CONSECUTIVE sweeps scales up one
+        replica; staying under ``autoscale_low_s`` scales down one —
+        never past the min/max bounds, and the counters reset whenever
+        the signal leaves the band (hysteresis means sustained pressure,
+        not one spike)."""
+        fc = self.fleet_ctx
+        if not fc.autoscale:
+            return
+        # RAW drain estimate (depth x unamortized EMA / max_batch), not
+        # retry_after_estimate: that one floors at 0.05 s as an
+        # anti-busy-spin backpressure hint, and a floor would read an
+        # IDLE fleet as permanently above any smaller high watermark.
+        estimates = [
+            len(eng._queue)
+            * eng.stats_.service_time_estimate()
+            / max(1, eng.serve.max_batch)
+            for idx, eng in enumerate(self.replicas)
+            if not self._draining[idx] and not self._retired[idx]
+            and eng.running
+        ]
+        if not estimates:
+            return
+        mean = sum(estimates) / len(estimates)
+        active = len(self._active_indices())
+        hysteresis = max(1, int(fc.autoscale_hysteresis))
+        if mean > fc.autoscale_high_s and active < fc.autoscale_max_replicas:
+            self._above_high += 1
+            self._below_low = 0
+            if self._above_high >= hysteresis:
+                self._above_high = 0
+                with self._lock:
+                    self._counters["fleet_scale_auto_ups"] += 1
+                reason = (f"autoscale: drain estimate {mean:.3f}s > "
+                          f"{fc.autoscale_high_s}s")
+                # Detached: a scale-up may spawn + warm a replica.
+                self._scale_in_background(
+                    lambda n=active + 1, r=reason: self.scale_to(n, r),
+                    "auto-up",
                 )
-                self.drain_replica(idx, reason=reason)
+        elif mean < fc.autoscale_low_s and active > fc.autoscale_min_replicas:
+            self._below_low += 1
+            self._above_high = 0
+            if self._below_low >= hysteresis:
+                self._below_low = 0
+                with self._lock:
+                    self._counters["fleet_scale_auto_downs"] += 1
+                reason = (f"autoscale: drain estimate {mean:.3f}s < "
+                          f"{fc.autoscale_low_s}s")
+                self._scale_in_background(
+                    lambda n=active - 1, r=reason: self.scale_to(n, r),
+                    "auto-down",
+                )
+        else:
+            self._above_high = 0
+            self._below_low = 0
 
     # -- request path ------------------------------------------------------
 
@@ -696,19 +1028,29 @@ class PartitionFleet:
 
     # -- drain + cross-replica resteer -------------------------------------
 
-    def drain_replica(self, idx: int, reason: str = "") -> None:
+    def drain_replica(self, idx: int, reason: str = "",
+                      retire: bool = False) -> None:
         """Take replica ``idx`` out of rotation: trip its fleet breaker,
         requeue its queued work on healthy replicas eagerly, then shut it
         down with the bounded drain (in-flight work finishes normally, or
         a hung dispatcher's futures are force-resolved typed and resteered
         lazily by their waiters).  Zero lost, zero duplicated resolutions
-        — asserted under concurrent overload in tests/test_fleet.py."""
+        — asserted under concurrent overload in tests/test_fleet.py.
+
+        ``retire`` (round 19): additionally mark the slot retired —
+        ``scale_to`` scale-downs and health-sweep *replacements* use it;
+        a retired slot is never probe-restored, only revived by a later
+        scale-up."""
         idx = int(idx)
         with self._lock:
-            if self._draining[idx]:
-                return
+            already = self._draining[idx]
             self._draining[idx] = True
-            self._counters["drains"] += 1
+            if retire:
+                self._retired[idx] = True
+            if not already:
+                self._counters["drains"] += 1
+        if already:
+            return
         eng = self.replicas[idx]
         self.breakers.get("replica", (idx,)).trip()
         self.breakers.record_demotion(
@@ -729,8 +1071,12 @@ class PartitionFleet:
             for req in eng._queue.drain_items():
                 with self._lock:
                     record = self._records.pop(id(req.future), None)
-                if record is not None:
-                    self._resteer(record, req.future)
+                if record is not None and self._resteer(record, req.future):
+                    # Re-homed: resolve the entry in THIS replica's
+                    # journal (round 19) — the sibling's journal owns the
+                    # work now, and an unresolved entry here would replay
+                    # already-completed work if the slot is later revived.
+                    eng.journal_mark_resteered(req.id)
                 # Resolve the abandoned engine future LAST: a waiter
                 # waking on it re-reads record.current, which already
                 # points elsewhere (or surfaces the typed error if the
@@ -900,6 +1246,7 @@ class PartitionFleet:
             counters = dict(self._counters)
             steered = list(self._steered)
             draining = list(self._draining)
+            retired = list(self._retired)
         per_replica = []
         agg_lanes = 0
         agg_occupancy = 0.0
@@ -910,6 +1257,7 @@ class PartitionFleet:
                 "replica": idx,
                 "running": eng.running,
                 "draining": draining[idx],
+                "retired": retired[idx],
                 "steered": steered[idx],
                 "queue_depth": snap["queue_depth"],
                 "completed": snap["completed"],
@@ -930,6 +1278,7 @@ class PartitionFleet:
             agg_occupancy += snap["batch_occupancy_max"]
         return {
             "replicas": len(self.replicas),
+            "active_replicas": len(self.replicas) - sum(retired),
             "running": self._started,
             **counters,
             "per_replica": per_replica,
@@ -1003,6 +1352,22 @@ class PartitionFleet:
             ("kaminpar_fleet_restores_total", "counter",
              "Drained replicas restored by the half-open probe",
              [({}, snap["restores"])]),
+            ("kaminpar_fleet_active_replicas", "gauge",
+             "Replicas participating in the fleet's elastic target size "
+             "(total minus retired slots)",
+             [({}, snap["active_replicas"])]),
+            ("kaminpar_fleet_scale_total", "counter",
+             "Elastic scaling events (round 19): scale_to calls by "
+             "direction, slot transitions (spawn/revive/retire), "
+             "health-sweep replacements, autoscale decisions",
+             [({"op": "up"}, snap["fleet_scale_ups"]),
+              ({"op": "down"}, snap["fleet_scale_downs"]),
+              ({"op": "spawn"}, snap["fleet_scale_spawns"]),
+              ({"op": "revive"}, snap["fleet_scale_revives"]),
+              ({"op": "retire"}, snap["fleet_scale_retires"]),
+              ({"op": "replacement"}, snap["fleet_scale_replacements"]),
+              ({"op": "auto_up"}, snap["fleet_scale_auto_ups"]),
+              ({"op": "auto_down"}, snap["fleet_scale_auto_downs"])]),
             ("kaminpar_fleet_warmup_cells_total", "counter",
              "Per-replica warmup cells by source: inherited from the "
              "fleet's warm state vs locally traced/compiled",

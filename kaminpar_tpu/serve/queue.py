@@ -48,13 +48,19 @@ class BoundedServeQueue:
         with self._cv:
             return self._closed
 
-    def put(self, item) -> None:
+    def put(self, item, force: bool = False) -> None:
         """Admit one request; raises :class:`QueueFullError` at the bound
-        and :class:`EngineStoppedError` after :meth:`close`."""
+        and :class:`EngineStoppedError` after :meth:`close`.
+
+        ``force`` (round 19) bypasses the bound — the journal replay
+        re-enqueues work that was ADMITTED by the dead process, so the
+        admission decision was already made once; bounding the replay
+        would lose accepted requests, the one thing the journal exists
+        to prevent (serve/journal.py)."""
         with self._cv:
             if self._closed:
                 raise EngineStoppedError("queue closed; engine is draining")
-            if len(self._dq) >= self.bound:
+            if not force and len(self._dq) >= self.bound:
                 raise QueueFullError()
             self._dq.append(item)
             self._cv.notify_all()

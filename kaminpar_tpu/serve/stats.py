@@ -69,6 +69,11 @@ class ServeStats:
         # quality demotions, and contained warmup-pass faults.
         "rejected_poisoned", "worker_hung", "watchdog_timeouts",
         "demoted_quality", "warmup_faults",
+        # Crash-safe journal census (round 19, serve/journal.py):
+        # unresolved admits re-enqueued at start() and resolution records
+        # appended at first-wins finalization — replay conservation means
+        # every journaled admit eventually gains exactly ONE resolution.
+        "journal_replayed", "journal_resolutions",
     )
 
     def __init__(self):

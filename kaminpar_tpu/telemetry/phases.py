@@ -97,6 +97,23 @@ AUX_PHASES = (
     # capacity verdict); a pull under this phase is a contract violation
     # and would be attributed loudly.
     "fleet_steer",
+    # Preemption-tolerant execution (round 19, ISSUE 15).
+    # checkpoint_write: the deep pipeline's level-boundary snapshots —
+    # each NEW coarse level's CSR arrays are pulled exactly once (cached
+    # host-side thereafter) plus one partition pull per uncoarsening
+    # boundary; deep.py asserts the writer's exact pull budget in-pipeline
+    # and ZERO pulls when checkpointing is disarmed.
+    # checkpoint_restore: resume-side hierarchy rebuild — host->device
+    # puts only, zero pulls (asserted).
+    "checkpoint_write",
+    "checkpoint_restore",
+    # Crash-safe serve journal (serve/journal.py): journal_write covers
+    # the admit-side graph serialization (ONE counted bulk pull per
+    # journaled admission via graph_to_host); journal_replay covers the
+    # restart-side replay enqueue (decode + host->device puts, zero
+    # pulls).
+    "journal_write",
+    "journal_replay",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

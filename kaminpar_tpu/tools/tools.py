@@ -585,6 +585,213 @@ def doctor(argv) -> int:
     return 0
 
 
+def _gen_graph(spec: str):
+    """Build a graph from a generator spec — ``rmat:S[:EF[:SEED]]``,
+    ``grid:RxC``, ``star:N`` — or read it from a file path.  Shared by
+    ``tools resume`` and the chaos preemption scenario (the killed child
+    and the resuming parent must agree on the graph bit for bit)."""
+    from ..graph import generators as gen
+
+    kind, _, rest = spec.partition(":")
+    if kind == "rmat":
+        parts = [int(x) for x in rest.split(":")] if rest else [10]
+        scale = parts[0]
+        ef = parts[1] if len(parts) > 1 else 8
+        seed = parts[2] if len(parts) > 2 else 0
+        return gen.rmat_graph(scale, edge_factor=ef, seed=seed)
+    if kind == "grid":
+        rows, _, cols = rest.partition("x")
+        return gen.grid2d_graph(int(rows), int(cols or rows))
+    if kind == "star":
+        return gen.star_graph(int(rest))
+    return _read(spec)
+
+
+def resume(argv) -> int:
+    """Resume a preempted deep run from its checkpoint (ISSUE 15):
+    validates the checkpoint fingerprint against the graph/context,
+    rebuilds the level stack into the same shape-ladder buckets, and
+    continues BIT-IDENTICAL to the uninterrupted run
+    (resilience/checkpoint.py).  ``--verify`` additionally reruns the
+    whole pipeline uninterrupted and asserts the identity."""
+    import time as _time
+
+    import numpy as _np
+
+    p = argparse.ArgumentParser(prog="resume")
+    p.add_argument("--ckpt", required=True,
+                   help="checkpoint file, or a directory (latest wins)")
+    p.add_argument("--graph", required=True,
+                   help="graph file or generator spec "
+                        "(rmat:S[:EF[:SEED]] / grid:RxC / star:N) — must "
+                        "be the dead run's graph; the fingerprint check "
+                        "rejects anything else")
+    p.add_argument("-k", type=int, required=True)
+    p.add_argument("-e", "--epsilon", type=float, default=0.03)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-P", "--preset", default="default")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the partition (one block id per line)")
+    p.add_argument("--verify", action="store_true",
+                   help="rerun uninterrupted and assert bit-identity")
+    args = p.parse_args(argv)
+
+    from ..graph import metrics
+    from ..kaminpar import KaMinPar
+    from ..presets import create_context_by_preset_name
+    from ..resilience.checkpoint import CheckpointMismatchError
+
+    g = _gen_graph(args.graph)
+
+    def _solver():
+        ctx = create_context_by_preset_name(args.preset)
+        ctx.seed = args.seed
+        s = KaMinPar(ctx)
+        s.set_graph(g)
+        return s
+
+    t0 = _time.monotonic()
+    try:
+        part = _solver().compute_partition(
+            args.k, args.epsilon, resume=args.ckpt
+        )
+    except CheckpointMismatchError as exc:
+        print(f"fingerprint mismatch: {exc}")
+        return 2
+    wall = _time.monotonic() - t0
+    cut = metrics.edge_cut(g, part)
+    print(f"resumed from {args.ckpt}: cut={cut} "
+          f"imbalance={metrics.imbalance(g, part, args.k):.4f} "
+          f"wall={wall:.1f}s")
+    if args.output:
+        _np.savetxt(args.output, part, fmt="%d")
+        print(f"wrote {args.output}")
+    if args.verify:
+        ref = _solver().compute_partition(args.k, args.epsilon)
+        identical = bool(_np.array_equal(ref, part))
+        print(f"verify: bit-identical to uninterrupted run: {identical}")
+        return 0 if identical else 1
+    return 0
+
+
+def _chaos_preemption(args) -> int:
+    """``tools chaos --preemption`` (ISSUE 15 satellite): SIGTERM a deep
+    run at a level boundary (the ``preempt`` injection point firing in a
+    child process with KPTPU_CHECKPOINT armed), resume from the surviving
+    checkpoint, verify bit-identity against the uninterrupted run, and
+    append ``chaos_preempt_*`` keys under the ``tools regress``
+    sentinel."""
+    import json as _json
+    import os as _os
+    import signal as _signal
+    import subprocess as _sub
+    import sys as _sys
+    import tempfile as _tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from ..kaminpar import KaMinPar
+    from ..presets import create_context_by_preset_name
+    from ..telemetry import ledger as led
+
+    spec = args.graph
+    g = _gen_graph(spec)
+
+    def _solver():
+        ctx = create_context_by_preset_name("default")
+        ctx.seed = args.seed
+        if args.climit:
+            ctx.coarsening.contraction_limit = args.climit
+        s = KaMinPar(ctx)
+        s.set_graph(g)
+        return s
+
+    t0 = _time.monotonic()
+    ref = _solver().compute_partition(args.k)
+    full_wall = _time.monotonic() - t0
+
+    ckpt_dir = _tempfile.mkdtemp(prefix="kptpu_preempt_")
+    plan = f"preempt:execute-fault:after={args.boundary - 1}:n=1"
+    env = dict(_os.environ)
+    env.update({
+        "KPTPU_CHECKPOINT": ckpt_dir,
+        "KPTPU_CHECKPOINT_EVERY": "1",
+        "KPTPU_FAULTS": plan,
+    })
+    child = _sub.run(
+        [_sys.executable, "-m", "kaminpar_tpu.tools", "chaos",
+         "--preempt-child", "--graph", spec, "-k", str(args.k),
+         "--seed", str(args.seed), "--climit", str(args.climit)],
+        env=env, capture_output=True, text=True, timeout=1800,
+    )
+    killed = child.returncode == -_signal.SIGTERM
+    ckpts = sorted(
+        f for f in _os.listdir(ckpt_dir) if f.startswith("ckpt_deep_")
+    )
+    if not killed or not ckpts:
+        print(f"preemption scenario FAILED: child rc={child.returncode} "
+              f"(want {-_signal.SIGTERM}), checkpoints={ckpts}")
+        print(child.stderr[-2000:])
+        return 1
+
+    t0 = _time.monotonic()
+    resumed = _solver().compute_partition(args.k, resume=ckpt_dir)
+    recover_s = _time.monotonic() - t0
+    identical = bool(_np.array_equal(ref, resumed))
+
+    record = {
+        "backend": _backend_name(),
+        "chaos_preempt_graph": spec,
+        "chaos_preempt_boundary": args.boundary,
+        # int, not bool: the ledger's metric extraction keeps numerics
+        "chaos_preempt_killed": int(killed),
+        "chaos_preempt_identical": int(identical),
+        "chaos_preempt_checkpoints": len(ckpts),
+        "chaos_preempt_recover_s": round(recover_s, 3),
+        "chaos_preempt_full_wall_s": round(full_wall, 3),
+    }
+    if not args.no_ledger:
+        led.append(led.build_entry(record, kind="chaos"),
+                   args.runs or led.default_path())
+    if args.as_json:
+        print(_json.dumps(record))
+    else:
+        print(f"chaos preemption: {spec} k={args.k} seed={args.seed} "
+              f"killed at boundary {args.boundary} (SIGTERM)")
+        print(f"  checkpoints survived: {ckpts}")
+        print(f"  resume bit-identical: {identical}")
+        print(f"  time-to-recover: {record['chaos_preempt_recover_s']}s "
+              f"(uninterrupted run: "
+              f"{record['chaos_preempt_full_wall_s']}s)")
+        if not args.no_ledger:
+            print("  ledger: appended kind=chaos entry")
+    return 0 if identical else 1
+
+
+def _chaos_preempt_child(args) -> int:
+    """Hidden child leg of the preemption scenario: run the deep
+    pipeline with checkpointing + the preempt fault armed via env — the
+    SIGTERM lands mid-run and this process dies at a level boundary
+    whose checkpoint is already durable."""
+    from ..kaminpar import KaMinPar
+    from ..presets import create_context_by_preset_name
+
+    g = _gen_graph(args.graph)
+    ctx = create_context_by_preset_name("default")
+    ctx.seed = args.seed
+    if args.climit:
+        ctx.coarsening.contraction_limit = args.climit
+    s = KaMinPar(ctx)
+    s.set_graph(g)
+    s.compute_partition(args.k)
+    # Reaching here means the plan never fired (too few boundaries for
+    # the requested kill index) — report it as a distinct exit code so
+    # the parent prints a useful verdict instead of "no checkpoints".
+    print("preempt point never fired (run had fewer boundaries)")
+    return 3
+
+
 def chaos(argv) -> int:
     """Injected-fault soak (ISSUE 13): run a short serve burst under an
     armed fault plan and report recovery — per-request outcomes,
@@ -593,11 +800,28 @@ def chaos(argv) -> int:
     metrics to RUNS.jsonl under the regress sentinel (kind="chaos"), so
     a recovery regression fails the gate like a perf regression.  Plans
     are seed-keyed (resilience/faults.py), so a soak replays
-    bit-for-bit under the same --plan/--seed."""
+    bit-for-bit under the same --plan/--seed.
+
+    ``--preemption`` (ISSUE 15) switches to the preemption scenario:
+    kill a checkpointing deep run at a level boundary, resume, verify
+    bit-identity + time-to-recover, and append ``chaos_preempt_*``
+    ledger keys."""
     import json as _json
     import time as _time
 
     p = argparse.ArgumentParser(prog="chaos")
+    p.add_argument("--preemption", action="store_true",
+                   help="kill+resume scenario instead of the serve soak")
+    p.add_argument("--preempt-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--graph", default="rmat:12:8:3",
+                   help="(preemption) graph spec, default rmat:12:8:3")
+    p.add_argument("--boundary", type=int, default=1,
+                   help="(preemption) 1-based level boundary to kill at")
+    p.add_argument("--climit", type=int, default=0,
+                   help="(preemption) coarsening contraction-limit "
+                        "override — small values force multi-level runs "
+                        "on small graphs (0 = preset default)")
     p.add_argument("--plan", default="execute@engine_request:execute-fault:n=2",
                    help="fault plan (resilience/faults.py syntax; default "
                         "fails the first 2 engine executes)")
@@ -615,6 +839,11 @@ def chaos(argv) -> int:
     p.add_argument("--no-ledger", action="store_true")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
+
+    if args.preempt_child:
+        return _chaos_preempt_child(args)
+    if args.preemption:
+        return _chaos_preemption(args)
 
     from ..graph.generators import rmat_graph
     from ..presets import create_context_by_preset_name
@@ -753,6 +982,7 @@ REGISTRY = {
     "compression": compression,
     "rearrange": rearrange,
     "regress": regress,
+    "resume": resume,
     "warmup": warmup,
     "trace": trace,
 }

@@ -28,6 +28,15 @@ class RandomState:
     def reseed(cls, seed: int) -> None:
         cls._tls.seed = int(seed)
         cls._tls.key = jax.random.key(int(seed))
+        # Chain position (round 19, ISSUE 15): the key after N draws is a
+        # pure function of (seed, N) — split is deterministic — so the whole
+        # RNG state serializes as a PAIR OF INTS, not an opaque blob.  The
+        # checkpoint/resume machinery (resilience/checkpoint.py) records
+        # (seed, draws) at every level boundary and fast-forwards on
+        # restore; ``phase_draws`` keeps a per-phase breakdown for the
+        # checkpoint's observability record (restore needs only the total).
+        cls._tls.draws = 0
+        cls._tls.phase_draws = {}
 
     @classmethod
     def seed(cls) -> int:
@@ -36,10 +45,51 @@ class RandomState:
         return cls._tls.seed
 
     @classmethod
+    def draws(cls) -> int:
+        """Splits consumed on this thread since the last reseed."""
+        return int(getattr(cls._tls, "draws", 0) or 0)
+
+    @classmethod
+    def chain_position(cls) -> tuple:
+        """(seed, draws): the serializable RNG chain position.  Feeding it
+        to :meth:`restore` reproduces the thread's key stream exactly —
+        the property that makes checkpoint/resume bit-identical."""
+        return (cls.seed(), cls.draws())
+
+    @classmethod
+    def phase_draws(cls) -> dict:
+        """{sync-stats phase: draws} breakdown since the last reseed."""
+        return dict(getattr(cls._tls, "phase_draws", None) or {})
+
+    @classmethod
+    def restore(cls, seed: int, draws: int) -> None:
+        """Reconstruct the chain at position (seed, draws): reseed, then
+        fast-forward ``draws`` splits.  Bit-identical to a chain that
+        arrived there by normal draws (asserted in tests/test_rng.py)."""
+        cls.reseed(seed)
+        for _ in range(int(draws)):
+            cls.next_key()
+        # The fast-forward's own phase attribution is meaningless (it
+        # replays draws whose phases already happened in the dead run).
+        cls._tls.phase_draws = {}
+        cls._tls.draws = int(draws)
+
+    @classmethod
     def next_key(cls):
         if getattr(cls._tls, "key", None) is None:
             cls.reseed(0)
         cls._tls.key, sub = jax.random.split(cls._tls.key)
+        cls._tls.draws = getattr(cls._tls, "draws", 0) + 1
+        try:
+            from . import sync_stats
+
+            phase = sync_stats.active_phase()
+            pd = getattr(cls._tls, "phase_draws", None)
+            if pd is None:
+                pd = cls._tls.phase_draws = {}
+            pd[phase] = pd.get(phase, 0) + 1
+        except Exception:  # noqa: BLE001 — accounting must never break draws
+            pass
         return sub
 
     @classmethod

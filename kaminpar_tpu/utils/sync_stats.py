@@ -76,6 +76,12 @@ def _phase() -> str:
     return stack[-1] if stack else _DEFAULT_PHASE
 
 
+def active_phase() -> str:
+    """This thread's innermost open phase (``"untracked"`` outside any
+    scope) — public for the RNG chain's per-phase draw accounting."""
+    return _phase()
+
+
 def push_phase(name: str) -> None:
     stack = getattr(_tls, "stack", None)
     if stack is None:

@@ -1,0 +1,318 @@
+"""Checkpoint/resume tests (ISSUE 15 tentpole a): deterministic
+level-boundary snapshots of the deep pipeline and BIT-IDENTICAL resume.
+
+The fast tier proves the full property chain in-process — every boundary
+of a multi-level run (coarsening AND uncoarsening stages) resumes to the
+uninterrupted run's exact partition, the writer's pulls stay inside the
+budget the pipeline asserts (and at ZERO when disarmed), fingerprints
+reject foreign runs, and the atomic-rename format round-trips.  The
+@slow tier adds the kill matrix the acceptance criteria name: a REAL
+SIGTERM (the ``preempt`` injection point, resilience/faults.py) at every
+level boundary of a scale-12 run across families x k, resumed from the
+surviving checkpoint in a fresh process."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.resilience import checkpoint as ckpt
+from kaminpar_tpu.resilience.checkpoint import CheckpointMismatchError
+from kaminpar_tpu.utils import sync_stats
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctx(d=None, seed=7, every=1, keep_all=True, climit=60):
+    ctx = create_context_by_preset_name("default")
+    ctx.seed = seed
+    # A small contraction limit produces several coarsening levels on a
+    # small graph — the boundary matrix stays cheap while covering both
+    # stages (the default C=2000 needs scale >= 13 for even one level).
+    ctx.coarsening.contraction_limit = climit
+    if d is not None:
+        ctx.resilience.checkpoint_dir = str(d)
+        ctx.resilience.checkpoint_every_levels = every
+        ctx.resilience.checkpoint_keep_all = keep_all
+    return ctx
+
+
+def _solve(g, k=4, d=None, resume=None, **kw):
+    solver = KaMinPar(_ctx(d, **kw))
+    solver.set_graph(g)
+    return solver.compute_partition(k, resume=resume)
+
+
+def _files(d):
+    return sorted(glob.glob(os.path.join(str(d), "ckpt_deep_b*.npz")))
+
+
+def _meta(path):
+    with np.load(path) as npz:
+        return json.loads(str(npz["meta"][()]))
+
+
+def _graph():
+    return generators.rmat_graph(9, edge_factor=4, seed=3)
+
+
+def test_disarmed_writes_nothing_and_pulls_nothing(tmp_path):
+    """Without checkpoint_dir the pipeline performs ZERO checkpoint_write
+    pulls — deep.py asserts the budget at 0 in-pipeline, so arming the
+    budget checks makes the run itself the assertion."""
+    g = _graph()
+    sync_stats.enable_budget_checks(True)
+    try:
+        _solve(g)
+    finally:
+        sync_stats.enable_budget_checks(False)
+    assert _files(tmp_path) == []
+
+
+def test_every_boundary_resumes_bit_identical(tmp_path):
+    """The core tentpole property: the armed run is bit-identical to the
+    reference, writes a checkpoint at EVERY level boundary (both
+    stages), and every one of those checkpoints resumes to the exact
+    reference partition.  The armed run's writer pulls stay inside the
+    exact entitlement deep.py asserts (budget checks armed)."""
+    g = _graph()
+    ref = _solve(g)
+    sync_stats.enable_budget_checks(True)
+    try:
+        armed = _solve(g, d=tmp_path)
+    finally:
+        sync_stats.enable_budget_checks(False)
+    assert np.array_equal(ref, armed)
+    files = _files(tmp_path)
+    assert len(files) >= 5
+    stages = {_meta(f)["stage"] for f in files}
+    assert stages == {"coarsening", "uncoarsening"}
+    # Monotone RNG chain positions: later boundaries embody more draws.
+    draws = [_meta(f)["rng"]["draws"] for f in files]
+    assert draws == sorted(draws)
+    for f in files:
+        got = _solve(g, resume=f)
+        assert np.array_equal(ref, got), f"resume from {f} diverged"
+
+
+def test_resume_state_object_and_directory_latest(tmp_path):
+    """resume= accepts a path, a directory (latest boundary wins), or a
+    pre-loaded CheckpointState."""
+    g = _graph()
+    ref = _solve(g)
+    _solve(g, d=tmp_path)
+    files = _files(tmp_path)
+    assert ckpt.latest(str(tmp_path)) == files[-1]
+    state = ckpt.load(str(tmp_path))
+    assert state.path == files[-1]
+    assert np.array_equal(ref, _solve(g, resume=state))
+    assert np.array_equal(ref, _solve(g, resume=str(tmp_path)))
+
+
+def test_checkpoint_every_levels_thins_boundaries(tmp_path):
+    g = _graph()
+    d1 = tmp_path / "every1"
+    d2 = tmp_path / "every2"
+    _solve(g, d=d1, every=1)
+    _solve(g, d=d2, every=2)
+    assert 0 < len(_files(d2)) < len(_files(d1))
+    # every=2 keeps exactly the even boundaries of the every=1 run.
+    assert {_meta(f)["boundary"] for f in _files(d2)} == {
+        b for b in (_meta(f)["boundary"] for f in _files(d1)) if b % 2 == 0
+    }
+
+
+def test_keep_latest_only_by_default(tmp_path):
+    g = _graph()
+    _solve(g, d=tmp_path, keep_all=False)
+    files = _files(tmp_path)
+    assert len(files) == 1
+    assert _meta(files[0])["num_levels"] == 0  # the final boundary
+
+
+def test_fingerprint_rejects_foreign_runs(tmp_path):
+    g = _graph()
+    _solve(g, d=tmp_path, keep_all=False)
+    f = _files(tmp_path)[0]
+    with pytest.raises(CheckpointMismatchError, match="seed"):
+        _solve(g, resume=f, seed=99)
+    with pytest.raises(CheckpointMismatchError, match="k="):
+        _solve(g, k=8, resume=f)
+    other = generators.rmat_graph(8, edge_factor=4, seed=4)
+    with pytest.raises(CheckpointMismatchError, match="graph_"):
+        _solve(other, resume=f)
+
+
+def test_knob_digest_governs_not_preset_name(tmp_path):
+    """A changed result-relevant knob (coarsening tree) must reject; the
+    advisory fields (preset name/git head) only warn."""
+    g = _graph()
+    _solve(g, d=tmp_path, keep_all=False)
+    f = _files(tmp_path)[0]
+    with pytest.raises(CheckpointMismatchError, match="knobs_digest"):
+        _solve(g, resume=f, climit=61)
+    state = ckpt.load(f)
+    state.fingerprint = dict(state.fingerprint, preset="renamed")
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        got = _solve(g, resume=state)
+    assert any("preset" in str(w.message) for w in wrec)
+    assert np.array_equal(_solve(g), got)
+
+
+def test_env_arming_and_every_override(tmp_path, monkeypatch):
+    g = _graph()
+    d = tmp_path / "envdir"
+    monkeypatch.setenv("KPTPU_CHECKPOINT", str(d))
+    monkeypatch.setenv("KPTPU_CHECKPOINT_EVERY", "2")
+    _solve(g)  # context itself is NOT armed: env alone arms
+    files = _files(d)
+    assert files
+    assert all(_meta(f)["boundary"] % 2 == 0 for f in files)
+
+
+def test_envelope_warns_once_and_disarms(tmp_path):
+    """Armed outside the envelope (no dense graph / v-cycle communities /
+    compressed source) the writer declines with one RuntimeWarning."""
+    ctx = _ctx(tmp_path)
+    ckpt._warned_envelope[0] = False
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        assert ckpt.writer_for(ctx, None) is None
+        assert ckpt.writer_for(ctx, None) is None  # second call: silent
+    assert sum("envelope" in str(w.message) for w in wrec) == 1
+    ckpt._warned_envelope[0] = False
+
+
+def test_atomic_format_tolerates_stray_tmp(tmp_path):
+    """A torn write (kill mid-serialization) leaves only a .tmp file —
+    latest() ignores it and the previous checkpoint stays loadable."""
+    g = _graph()
+    _solve(g, d=tmp_path, keep_all=False)
+    f = _files(tmp_path)[0]
+    (tmp_path / "ckpt_deep_b9999.npz.tmp12345").write_bytes(b"torn")
+    assert ckpt.latest(str(tmp_path)) == f
+    assert ckpt.load(str(tmp_path)).path == f
+
+
+def test_armed_resume_does_not_rewrite_restored_boundary(tmp_path):
+    """A resumed run that is ITSELF armed (preempted under
+    KPTPU_CHECKPOINT, resumed under it too) continues the dead run's
+    boundary numbering instead of re-writing the restored boundary —
+    the write cadence (checkpoint_every_levels phase) must match the
+    uninterrupted run's."""
+    g = _graph()
+    d1 = tmp_path / "first"
+    _solve(g, d=d1)
+    files = _files(d1)
+    uncoarsen = [f for f in files if _meta(f)["stage"] == "uncoarsening"]
+    state = ckpt.load(uncoarsen[0])
+    d2 = tmp_path / "resumed"
+    ref = _solve(g)
+    got = _solve(g, d=d2, resume=state)
+    assert np.array_equal(ref, got)
+    resumed_bounds = [_meta(f)["boundary"] for f in _files(d2)]
+    # Strictly AFTER the restored boundary (no duplicate write of it),
+    # and exactly the uninterrupted run's remaining boundary numbers.
+    all_bounds = [_meta(f)["boundary"] for f in files]
+    assert resumed_bounds == [b for b in all_bounds if b > state.boundary]
+
+
+def _run_preempt_child(spec, k, seed, boundary, ckpt_dir, climit=0,
+                       timeout=900):
+    """SIGTERM a checkpointing deep run at 1-based level boundary
+    ``boundary`` in a fresh process (the tools chaos --preempt-child
+    leg); returns the completed process."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KPTPU_CHECKPOINT=str(ckpt_dir),
+        KPTPU_CHECKPOINT_EVERY="1",
+        KPTPU_FAULTS=f"preempt:execute-fault:after={boundary - 1}:n=1",
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "kaminpar_tpu.tools", "chaos",
+         "--preempt-child", "--graph", spec, "-k", str(k),
+         "--seed", str(seed), "--climit", str(climit)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO,
+    )
+
+
+def test_sigterm_preemption_resumes_bit_identical(tmp_path):
+    """One REAL kill in tier-1: a subprocess multi-level deep run dies
+    to SIGTERM at a mid-run level boundary (checkpoint already durable —
+    the preempt point fires after the write), and the resumed run
+    matches the reference bit for bit.  The full scale-12 kill matrix
+    is @slow below."""
+    spec, k, seed = "rmat:9:4:3", 4, 7
+    g = _graph()
+    ref = _solve(g, k=k)
+    child = _run_preempt_child(spec, k, seed, boundary=2,
+                               ckpt_dir=tmp_path, climit=60)
+    assert child.returncode == -signal.SIGTERM, child.stderr[-1000:]
+    files = _files(tmp_path)
+    assert files, "no checkpoint survived the kill"
+    got = _solve(g, k=k, resume=str(tmp_path))
+    assert np.array_equal(ref, got)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec,factory", [
+    ("rmat:12:8:3",
+     lambda: generators.rmat_graph(12, edge_factor=8, seed=3)),
+    ("grid:64x64", lambda: generators.grid2d_graph(64, 64)),
+    ("star:4095", lambda: generators.star_graph(4095)),
+])
+@pytest.mark.parametrize("k", [4, 8])
+def test_kill_anywhere_matrix_scale12(tmp_path, spec, factory, k):
+    """Acceptance matrix: for EVERY level boundary of a scale-12 deep
+    run (three families x two k), SIGTERM at that boundary + resume is
+    bit-identical to the uninterrupted run."""
+    g = factory()
+    seed = 7
+    ref = _solve(g, k=k, climit=2000)
+    # Discover the boundary count from an uninterrupted armed run.
+    probe_dir = tmp_path / "probe"
+    _solve(g, k=k, d=probe_dir, climit=2000)
+    boundaries = [_meta(f)["boundary"] for f in _files(probe_dir)]
+    assert boundaries
+    for b in boundaries:
+        kill_dir = tmp_path / f"kill_b{b}"
+        kill_dir.mkdir()
+        child = _run_preempt_child(spec, k, seed, boundary=b,
+                                   ckpt_dir=kill_dir)
+        assert child.returncode == -signal.SIGTERM, (
+            f"boundary {b}: rc={child.returncode}\n{child.stderr[-800:]}"
+        )
+        assert _files(kill_dir), f"boundary {b}: no checkpoint survived"
+        got = _solve(g, k=k, resume=str(kill_dir), climit=2000)
+        assert np.array_equal(ref, got), f"boundary {b} diverged"
+
+
+def test_chaos_preemption_tool(tmp_path):
+    """``tools chaos --preemption`` end-to-end: kill + resume + verdict
+    + chaos_preempt_* record keys (ledger suppressed)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "kaminpar_tpu.tools", "chaos",
+         "--preemption", "--graph", "rmat:9:4:3", "-k", "4",
+         "--boundary", "1", "--no-ledger", "--json"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=_REPO,
+    )
+    assert out.returncode == 0, out.stderr[-1000:] + out.stdout[-500:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["chaos_preempt_killed"] == 1
+    assert rec["chaos_preempt_identical"] == 1
+    assert rec["chaos_preempt_recover_s"] >= 0.0

@@ -1,0 +1,387 @@
+"""Elastic fleet tests (ISSUE 15 tentpole c): ``scale_to`` under live
+traffic with conserved resolutions, retired-slot revival vs fresh
+spawning, autoscale watermarks with hysteresis, health-sweep
+replacement, and the ``fleet_scale_*`` observability surface."""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.resilience import breakers as rbreakers
+from kaminpar_tpu.serve.fleet import PartitionFleet
+from kaminpar_tpu.telemetry import prometheus
+
+
+@pytest.fixture(autouse=True)
+def _quiet_and_clean():
+    rbreakers.reset_global_registry()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        yield
+    rbreakers.reset_global_registry()
+
+
+def _fleet(replicas=2, ctx=None, **kw):
+    ctx = ctx or create_context_by_preset_name("serve")
+    kw.setdefault("warm_ladder", ())
+    kw.setdefault("warm_ks", ())
+    kw.setdefault("queue_bound", 64)
+    kw.setdefault("max_batch", 4)
+    return PartitionFleet(ctx, replicas=replicas, **kw)
+
+
+def _graphs(n, base=60):
+    return [
+        generators.rmat_graph(7, edge_factor=4, seed=base + i)
+        for i in range(n)
+    ]
+
+
+def _wait_active(fleet, n, timeout=120):
+    """Sweep-triggered scaling (autoscale/replacement) runs detached —
+    poll the active count instead of asserting instantly."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fleet.active_replicas == n:
+            bg = fleet._bg_scale
+            if bg is None or not bg.is_alive():
+                return True
+        time.sleep(0.05)
+    return False
+
+
+class _Burst:
+    """8-thread live traffic against a fleet; every submitted request is
+    accounted as exactly one resolution or one typed rejection."""
+
+    def __init__(self, fleet, graphs, threads=8):
+        self.fleet = fleet
+        self.graphs = graphs
+        self.results: list = []
+        self.errors: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker, args=(t,))
+            for t in range(threads)
+        ]
+
+    def _worker(self, tid):
+        i = 0
+        while not self._stop.is_set():
+            g = self.graphs[(tid + i) % len(self.graphs)]
+            try:
+                fut = self.fleet.submit(g, 4, graph_id=f"tenant{tid}")
+                res = fut.result(timeout=300)
+                with self._lock:
+                    self.results.append((tid, res))
+            except Exception as exc:  # noqa: BLE001 — typed rejects count
+                with self._lock:
+                    self.errors.append(type(exc).__name__)
+            i += 1
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=600)
+
+    @property
+    def accounted(self):
+        with self._lock:
+            return len(self.results) + len(self.errors)
+
+
+def test_scale_up_down_conserves_resolutions_under_live_burst():
+    """The acceptance shape: scale 2 -> 3 -> 1 under an 8-thread live
+    burst — zero lost (every submit resolves or rejects typed, none
+    hangs), zero duplicated resolutions, and the router counters add
+    up."""
+    fleet = _fleet(replicas=2)
+    fleet.start(warmup=False)
+    try:
+        with _Burst(fleet, _graphs(4)) as burst:
+            time.sleep(2.0)
+            up = fleet.scale_to(3)
+            assert up["active"] == 3 and up["spawned"] == [2]
+            time.sleep(2.0)
+            down = fleet.scale_to(1)
+            assert down["active"] == 1
+            assert sorted(down["retired"], reverse=True) == down["retired"]
+            time.sleep(2.0)
+        stats = fleet.stats()
+        # Conservation: every submitted request is accounted exactly once.
+        assert stats["submitted"] == burst.accounted
+        assert burst.results, "burst produced no resolutions"
+        assert stats["fleet_scale_ups"] == 1
+        assert stats["fleet_scale_downs"] == 1
+        assert stats["fleet_scale_spawns"] == 1
+        assert stats["fleet_scale_retires"] == 2
+        assert stats["active_replicas"] == 1
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_scale_down_to_one_keeps_serving():
+    fleet = _fleet(replicas=3)
+    fleet.start(warmup=False)
+    try:
+        g = _graphs(1)[0]
+        ref = fleet.submit(g, 4).result(timeout=300).partition
+        fleet.scale_to(1)
+        assert fleet.active_replicas == 1
+        # The survivor is replica 0 and still serves bit-identically.
+        res = fleet.submit(g, 4).result(timeout=300)
+        assert (res.partition == ref).all()
+        stats = fleet.stats()
+        assert [r["retired"] for r in stats["per_replica"]] == [
+            False, True, True,
+        ]
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_scale_up_revives_retired_slot_before_spawning():
+    """A retired slot's engine object survives retirement — scale-up
+    revives it (warm state carries over, no fresh replica object) before
+    any spawn."""
+    fleet = _fleet(replicas=2)
+    fleet.start(warmup=False)
+    try:
+        engines = list(fleet.replicas)
+        fleet.scale_to(1)
+        # The retire-drain runs detached (live traffic must not block on
+        # it): wait for the slot's engine to stop.
+        deadline = time.monotonic() + 60
+        while fleet.replicas[1].running and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not fleet.replicas[1].running
+        up = fleet.scale_to(2)
+        assert up["revived"] == [1] and not up["spawned"]
+        assert fleet.replicas[1] is engines[1]  # same object, revived
+        assert fleet.replicas[1].running
+        assert len(fleet.replicas) == 2
+        stats = fleet.stats()
+        assert stats["fleet_scale_revives"] == 1
+        assert stats["fleet_scale_spawns"] == 0
+        # The revived slot's fleet breaker is administratively closed —
+        # it is routable immediately, no half-open probe spent.
+        assert fleet.breakers.get("replica", (1,)).state == "closed"
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_retired_slot_is_not_probe_restorable():
+    """Retirement is intentional: unlike a health drain, no half-open
+    probe may bring the slot back — only scale_to revives it."""
+    ctx = create_context_by_preset_name("serve")
+    ctx.fleet.replica_cooldown_s = 0.05
+    fleet = _fleet(replicas=2, ctx=ctx)
+    fleet.start(warmup=False)
+    try:
+        fleet.scale_to(1)
+        time.sleep(0.2)  # well past the breaker cooldown
+        ok, is_probe = fleet._replica_available(1)
+        assert not ok and not is_probe
+        assert fleet.stats()["restores"] == 0
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_sticky_tenants_rehome_on_scale_down():
+    fleet = _fleet(replicas=2)
+    fleet.start(warmup=False)
+    try:
+        g = _graphs(1)[0]
+        # Pin a tenant's first request onto replica 1, making it home.
+        fut = fleet.submit(g, 4, graph_id="tenant-x", replica=1)
+        fut.result(timeout=300)
+        fleet._sticky["tenant-x"] = 1  # explicit-pin path does not bind
+        fleet.scale_to(1)
+        fut = fleet.submit(g, 4, graph_id="tenant-x")
+        fut.result(timeout=300)
+        assert fut.replica == 0
+        stats = fleet.stats()
+        assert stats["sticky_moves"] >= 1
+        assert fleet._sticky["tenant-x"] == 0
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_autoscale_scales_up_on_sustained_pressure_with_hysteresis():
+    ctx = create_context_by_preset_name("serve")
+    ctx.fleet.autoscale = True
+    ctx.fleet.autoscale_min_replicas = 1
+    ctx.fleet.autoscale_max_replicas = 2
+    ctx.fleet.autoscale_high_s = 0.0   # any queued work is "pressure"
+    ctx.fleet.autoscale_low_s = -1.0   # never scale down here
+    ctx.fleet.autoscale_hysteresis = 2
+    fleet = _fleet(replicas=1, ctx=ctx, max_batch=2)
+    fleet.start(warmup=False)
+    # Only the EXPLICIT sweep calls below count toward hysteresis (the
+    # submit-path sweep is throttled out of the way).
+    fleet._health_interval_s = 1e9
+    try:
+        # Seed the service EMA (warmup would): the raw drain estimate is
+        # depth x EMA / max_batch, so queued work now reads as pressure.
+        fleet.replicas[0].stats_.seed_service_time(1.0)
+        fleet.pause()  # queued work builds the drain estimate
+        g = _graphs(1)[0]
+        futs = [fleet.submit(g, 4)]
+        # Sweep 1 counts toward hysteresis; no scaling yet.
+        fleet._autoscale_sweep()
+        assert fleet.active_replicas == 1
+        # Sweep 2 crosses the hysteresis bar -> one replica added (the
+        # action runs detached off the sweep thread).
+        fleet._autoscale_sweep()
+        assert _wait_active(fleet, 2)
+        stats = fleet.stats()
+        assert stats["fleet_scale_auto_ups"] == 1
+        # Bounded: further pressure cannot exceed autoscale_max_replicas.
+        fleet._autoscale_sweep()
+        fleet._autoscale_sweep()
+        time.sleep(0.2)
+        assert fleet.active_replicas == 2
+        fleet.resume()
+        for f in futs:
+            f.result(timeout=300)
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_autoscale_scales_down_when_idle_and_respects_min():
+    ctx = create_context_by_preset_name("serve")
+    ctx.fleet.autoscale = True
+    ctx.fleet.autoscale_min_replicas = 1
+    ctx.fleet.autoscale_max_replicas = 3
+    ctx.fleet.autoscale_high_s = 1e9
+    ctx.fleet.autoscale_low_s = 1e9   # everything is "idle"
+    ctx.fleet.autoscale_hysteresis = 1
+    fleet = _fleet(replicas=2, ctx=ctx)
+    fleet.start(warmup=False)
+    try:
+        fleet._autoscale_sweep()
+        assert _wait_active(fleet, 1)
+        assert fleet.stats()["fleet_scale_auto_downs"] == 1
+        # At the floor: no further scale-down.
+        fleet._autoscale_sweep()
+        time.sleep(0.2)
+        assert fleet.active_replicas == 1
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_autoscale_hysteresis_resets_when_signal_leaves_band():
+    ctx = create_context_by_preset_name("serve")
+    ctx.fleet.autoscale = True
+    ctx.fleet.autoscale_high_s = 0.0
+    ctx.fleet.autoscale_low_s = -1.0
+    ctx.fleet.autoscale_hysteresis = 3
+    ctx.fleet.autoscale_max_replicas = 2
+    fleet = _fleet(replicas=1, ctx=ctx, max_batch=2)
+    fleet.start(warmup=False)
+    fleet._health_interval_s = 1e9  # explicit sweeps only
+    try:
+        fleet.replicas[0].stats_.seed_service_time(1.0)
+        fleet.pause()
+        g = _graphs(1)[0]
+        fut = fleet.submit(g, 4)
+        fleet._autoscale_sweep()
+        fleet._autoscale_sweep()
+        assert fleet._above_high == 2
+        # Pressure clears (drain the queue) -> the streak resets.
+        fleet.resume()
+        fut.result(timeout=300)
+        fleet._autoscale_sweep()
+        assert fleet._above_high == 0
+        assert fleet.active_replicas == 1
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_health_sweep_replaces_watchdog_fired_replica():
+    """A replica the health sweep condemns is REPLACED, not just
+    drained: a fresh replica spawns at a new index, the sick slot is
+    retired (never probe-revived into rotation), and active capacity is
+    back to target immediately."""
+    ctx = create_context_by_preset_name("serve")
+    ctx.fleet.auto_drain = True
+    ctx.fleet.replace_drained = True
+    fleet = _fleet(replicas=2, ctx=ctx)
+    fleet.start(warmup=False)
+    fleet._health_interval_s = 0.0
+    try:
+        fleet.replicas[1].stats_.bump("watchdog_timeouts")
+        g = _graphs(1)[0]
+        fleet.submit(g, 4).result(timeout=300)  # submit runs the sweep
+        assert _wait_active(fleet, 2)  # replacement spawns detached
+        stats = fleet.stats()
+        assert stats["fleet_scale_replacements"] == 1
+        assert stats["fleet_scale_spawns"] == 1
+        assert stats["replicas"] == 3
+        assert stats["active_replicas"] == 2
+        assert [r["retired"] for r in stats["per_replica"]] == [
+            False, True, False,
+        ]
+        # The replacement serves traffic.
+        fleet.submit(g, 4, replica=2).result(timeout=300)
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_spawned_replica_inherits_warm_state():
+    """Scale-up spawning inherits the fleet's warm state (and journals
+    nothing until started): the new replica's warmup raises ZERO compile
+    events for inherited cells."""
+    from kaminpar_tpu.utils import compile_stats
+
+    fleet = _fleet(replicas=1, warm_ladder=(7,), warm_ks=(4,))
+    fleet.start(warmup=True)
+    try:
+        before = compile_stats.compile_time_snapshot().get(
+            "compile_events", 0
+        )
+        fleet.scale_to(2)
+        delta = compile_stats.compile_time_snapshot().get(
+            "compile_events", 0
+        ) - before
+        assert delta == 0, f"spawned replica compiled {delta} executables"
+        cells = fleet.replicas[1].warmup_cell_counts()
+        assert cells["inherited"] > 0 and cells["local"] == 0
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_scale_counters_exposed_in_prometheus():
+    fleet = _fleet(replicas=2)
+    fleet.start(warmup=False)
+    try:
+        fleet.scale_to(1)
+        fleet.scale_to(2)
+        text = fleet.metrics_text()
+        prometheus.validate(text)
+        assert 'kaminpar_fleet_scale_total{op="down"} 1' in text
+        assert 'kaminpar_fleet_scale_total{op="up"} 1' in text
+        assert 'kaminpar_fleet_scale_total{op="revive"} 1' in text
+        assert 'kaminpar_fleet_scale_total{op="retire"} 1' in text
+        assert "kaminpar_fleet_active_replicas 2" in text
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_scale_to_rejects_when_not_started():
+    from kaminpar_tpu.serve.errors import EngineStoppedError
+
+    fleet = _fleet(replicas=1)
+    with pytest.raises(EngineStoppedError):
+        fleet.scale_to(2)

@@ -1,0 +1,453 @@
+"""Crash-safe serve journal tests (ISSUE 15 tentpole b): append-only
+admit/resolve records, idempotent replay after a dead engine, warm-state
+restoration with a ZERO warmup compile-event delta, and conservation —
+every journaled admit ends with exactly ONE resolution, however the
+process died.  The @slow tier SIGKILLs a real serve CLI process
+mid-burst and replays its journal."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graph import generators
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.serve import journal as J
+from kaminpar_tpu.serve.engine import PartitionEngine
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctx(path, fsync_every=2):
+    ctx = create_context_by_preset_name("serve")
+    ctx.serve.journal_path = str(path)
+    ctx.serve.journal_fsync_every = fsync_every
+    return ctx
+
+
+def _engine(path, **kw):
+    kw.setdefault("warm_ladder", ())
+    kw.setdefault("warm_ks", ())
+    kw.setdefault("queue_bound", 16)
+    kw.setdefault("max_batch", 4)
+    return PartitionEngine(_ctx(path), **kw)
+
+
+def _graphs(n, scale=7, base=50):
+    return [
+        generators.rmat_graph(scale, edge_factor=4, seed=base + i)
+        for i in range(n)
+    ]
+
+
+def _wait_unresolved_empty(path, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not J.read_journal(str(path))["unresolved"]:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+# -- file format -------------------------------------------------------------
+
+
+def test_journal_append_and_batched_fsync(tmp_path):
+    path = tmp_path / "j.jsonl"
+    jr = J.ServeJournal(str(path), fsync_every=3)
+    for i in range(7):
+        jr.append({"t": "admit", "id": i + 1})
+    snap = jr.snapshot()
+    assert snap["appended"] == 7
+    assert snap["fsyncs"] == 2  # batched: at appends 3 and 6
+    jr.append({"t": "resolve", "id": 1, "ok": 1}, force_fsync=True)
+    assert jr.snapshot()["fsyncs"] == 3
+    jr.close()
+    assert jr.snapshot()["fsyncs"] == 4  # close fsyncs the tail
+    jr.append({"t": "admit", "id": 99})  # post-close: silently dropped
+    view = J.read_journal(str(path))
+    assert view["admits"] == 7
+    assert view["max_id"] == 7
+
+
+def test_read_journal_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"t": "admit", "id": 1, "k": 4}) + "\n")
+        f.write(json.dumps({"t": "resolve", "id": 1, "ok": 1}) + "\n")
+        f.write(json.dumps({"t": "admit", "id": 2, "k": 4}) + "\n")
+        f.write('{"t": "adm')  # kill mid-append
+    view = J.read_journal(str(path))
+    assert view["torn"] == 1
+    assert [r["id"] for r in view["unresolved"]] == [2]
+    assert view["resolved"] == {1: 1}
+    assert view["max_id"] == 2
+
+
+def test_read_journal_missing_file(tmp_path):
+    view = J.read_journal(str(tmp_path / "nope.jsonl"))
+    assert view["unresolved"] == [] and view["max_id"] == 0
+
+
+def test_compact_keeps_unresolved_and_latest_warm_state(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"t": "warm_state", "warmup_report": []}) + "\n")
+        f.write(json.dumps({"t": "admit", "id": 1, "k": 4}) + "\n")
+        f.write(json.dumps({"t": "resolve", "id": 1, "ok": 1}) + "\n")
+        f.write(json.dumps({"t": "admit", "id": 2, "k": 8}) + "\n")
+        f.write(json.dumps({"t": "warm_state", "warmup_report": [],
+                            "marker": "latest"}) + "\n")
+        f.write('{"torn')
+    dropped = J.compact(str(path))
+    assert dropped == 4  # resolved pair + stale warm state + torn line
+    view = J.read_journal(str(path))
+    assert [r["id"] for r in view["unresolved"]] == [2]
+    assert view["warm_state"]["marker"] == "latest"
+    assert view["torn"] == 0
+    assert view["max_id"] == 2
+    # Idempotent: a second pass has nothing to drop.
+    assert J.compact(str(path)) == 0
+
+
+def test_graph_payload_round_trip():
+    g = generators.rmat_graph(7, edge_factor=4, seed=9)
+    payload = J.encode_graph(g)
+    back = J.decode_graph(payload)
+    assert back.n == g.n and back.m == g.m
+    for attr in ("row_ptr", "col_idx", "node_w", "edge_w"):
+        assert np.array_equal(
+            np.asarray(getattr(back, attr))[: back.n + 1 if attr == "row_ptr"
+                                            else back.m],
+            np.asarray(getattr(g, attr))[: g.n + 1 if attr == "row_ptr"
+                                         else g.m],
+        )
+
+
+# -- live engine -------------------------------------------------------------
+
+
+def test_clean_burst_resolves_every_admit(tmp_path):
+    path = tmp_path / "serve.jsonl"
+    eng = _engine(path)
+    eng.start(warmup=False)
+    try:
+        futs = [eng.submit(g, 4) for g in _graphs(5)]
+        for f in futs:
+            f.result(timeout=300)
+        # Resolutions force an fsync, so the mid-run view is complete:
+        # one admit + exactly one resolution each.
+        view = J.read_journal(str(path))
+        assert view["admits"] == 5
+        assert not view["unresolved"]
+        assert all(c == 1 for c in view["resolved"].values())
+    finally:
+        eng.shutdown(drain=True)
+    # Clean shutdown compacts the history down to recovery needs:
+    # nothing unresolved, just the final warm state.
+    view = J.read_journal(str(path))
+    assert view["admits"] == 0
+    assert not view["unresolved"]
+    assert view["warm_state"] is not None
+
+
+def test_restart_replays_unresolved_idempotently(tmp_path):
+    """The crash shape: an engine admits a burst it never dispatches
+    (paused), dies hard — the restarted engine replays every unresolved
+    admit exactly once: zero lost, zero duplicated resolutions."""
+    path = tmp_path / "serve.jsonl"
+    e1 = _engine(path)
+    e1.start(warmup=False)
+    e1.pause()
+    for g in _graphs(6):
+        e1.submit(g, 4)
+    # Non-draining shutdown rejects queued work with EngineStoppedError —
+    # the "engine gave it back" class the journal deliberately does NOT
+    # record as a resolution, leaving the entries replayable.
+    e1.shutdown(drain=False)
+    view = J.read_journal(str(path))
+    assert view["admits"] == 6
+    assert len(view["unresolved"]) == 6
+
+    e2 = _engine(path)
+    e2.start(warmup=False)
+    try:
+        assert _wait_unresolved_empty(path)
+        live = e2.stats()
+        assert live["journal"]["path"] == str(path)
+        # Pre-compaction view: the replay produced exactly ONE
+        # resolution per admit (conservation).
+        view = J.read_journal(str(path))
+        assert not view["unresolved"]
+        assert len(view["resolved"]) == 6
+        assert all(c == 1 for c in view["resolved"].values())
+    finally:
+        e2.shutdown(drain=True)
+    assert not J.read_journal(str(path))["unresolved"]
+    stats = e2.stats()
+    assert stats["journal_replayed"] == 6
+    assert stats["journal_resolutions"] == 6
+
+
+def test_restart_mid_burst_under_concurrent_load(tmp_path):
+    """Crash mid-burst with SOME requests already resolved: the restart
+    replays only the unresolved suffix, and the final journal carries
+    exactly one resolution per admit (conservation under load)."""
+    path = tmp_path / "serve.jsonl"
+    e1 = _engine(path, max_batch=2)
+    e1.start(warmup=False)
+    graphs = _graphs(8)
+    futs = [e1.submit(g, 4) for g in graphs[:4]]
+    for f in futs:
+        f.result(timeout=300)
+    e1.pause()  # the second half stays queued = "in flight at the kill"
+    for g in graphs[4:]:
+        e1.submit(g, 4)
+    e1.shutdown(drain=False)
+    # The bounded shutdown compacts: the 4 delivered resolutions (and
+    # their admits) are history, the 4 undelivered admits survive with
+    # their ORIGINAL ids.
+    view = J.read_journal(str(path))
+    assert len(view["unresolved"]) == 4
+    assert view["max_id"] == 8  # ids 5..8 kept: no fresh-id collision
+
+    e2 = _engine(path, max_batch=2)
+    e2.start(warmup=False)
+    try:
+        assert _wait_unresolved_empty(path)
+        # New traffic lands on fresh ids PAST the dead run's (no replay
+        # collision) and resolves normally alongside the replay.
+        e2.submit(graphs[0], 4).result(timeout=300)
+        view = J.read_journal(str(path))
+        assert not view["unresolved"]
+        # 4 replayed (resolving under their ORIGINAL ids 5..8) + 1 fresh
+        # admission whose id lands PAST every id the engine handed out.
+        assert view["admits"] == 5
+        assert all(c == 1 for c in view["resolved"].values())
+        assert len(view["resolved"]) == 5
+        assert set(view["resolved"]) > {5, 6, 7, 8}
+        assert max(view["resolved"]) > 8
+    finally:
+        e2.shutdown(drain=True)
+    assert not J.read_journal(str(path))["unresolved"]
+
+
+def test_failed_request_is_resolved_not_replayed(tmp_path):
+    """A genuine per-request failure (not an engine give-back) writes an
+    ok=0 resolution — the caller SAW the error, so a restart must not
+    resurrect the request."""
+    path = tmp_path / "serve.jsonl"
+    eng = _engine(path)
+    eng.start(warmup=False)
+    try:
+        g = _graphs(1)[0]
+        fut = eng.submit(g, 4, deadline_ms=0.001)  # expires in-queue
+        with pytest.raises(Exception):
+            fut.result(timeout=60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if not J.read_journal(str(path))["unresolved"]:
+                break
+            time.sleep(0.1)
+        view = J.read_journal(str(path))
+        assert view["admits"] == 1
+        assert not view["unresolved"]
+    finally:
+        eng.shutdown(drain=True)
+    assert not J.read_journal(str(path))["unresolved"]
+
+
+def test_warm_state_restores_with_zero_compile_delta(tmp_path):
+    """Engine restart restores the warmup report + warm cells through
+    the journal's warm-state record (the PR 14 inheritance path): the
+    restarted replica's warmup raises ZERO compile events."""
+    from kaminpar_tpu.utils import compile_stats
+
+    path = tmp_path / "serve.jsonl"
+    e1 = _engine(path, warm_ladder=(7,), warm_ks=(4,))
+    e1.start(warmup=True)
+    report_rows = len(e1.warmup_report)
+    assert report_rows > 0
+    e1.shutdown(drain=True)
+
+    before = compile_stats.compile_time_snapshot().get("compile_events", 0)
+    e2 = _engine(path, warm_ladder=(7,), warm_ks=(4,))
+    e2.start(warmup=True)
+    delta = (
+        compile_stats.compile_time_snapshot().get("compile_events", 0)
+        - before
+    )
+    try:
+        assert delta == 0, f"restarted warmup compiled {delta} executables"
+        inherited = [r for r in e2.warmup_report if r.get("inherited")]
+        assert len(inherited) == report_rows
+        assert e2.stats()["warmup_cells"]["inherited"] == report_rows
+    finally:
+        e2.shutdown(drain=True)
+
+
+def test_warm_state_restores_breaker_trips(tmp_path):
+    path = tmp_path / "serve.jsonl"
+    e1 = _engine(path)
+    e1.start(warmup=False)
+    e1.breakers.get("cell", (256, 1024, 4)).trip()
+    e1.shutdown(drain=True)
+
+    e2 = _engine(path)
+    e2.start(warmup=False)
+    try:
+        assert e2.breakers.get("cell", (256, 1024, 4)).state == "open"
+    finally:
+        e2.shutdown(drain=True)
+
+
+def test_env_override_arms_journal(tmp_path, monkeypatch):
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setenv("KPTPU_SERVE_JOURNAL", str(path))
+    ctx = create_context_by_preset_name("serve")  # context NOT armed
+    eng = PartitionEngine(ctx, warm_ladder=(), warm_ks=(),
+                          queue_bound=8, max_batch=2)
+    eng.start(warmup=False)
+    try:
+        eng.submit(_graphs(1)[0], 4).result(timeout=300)
+        # Pre-compaction view: the env-armed journal recorded the admit.
+        assert J.read_journal(str(path))["admits"] == 1
+    finally:
+        eng.shutdown(drain=True)
+    assert path.exists()
+    assert not J.read_journal(str(path))["unresolved"]
+
+
+def test_fleet_replicas_get_per_slot_journals(tmp_path):
+    """One shared journal across N replicas would interleave colliding
+    request ids — the fleet suffixes each replica's path."""
+    import warnings
+
+    from kaminpar_tpu.serve.fleet import PartitionFleet
+
+    path = tmp_path / "fleet.jsonl"
+    ctx = _ctx(path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        fleet = PartitionFleet(ctx, replicas=2, warm_ladder=(),
+                               warm_ks=(), queue_bound=8, max_batch=2)
+        fleet.start(warmup=False)
+        try:
+            g = _graphs(1)[0]
+            fleet.submit(g, 4, replica=0).result(timeout=300)
+            fleet.submit(g, 4, replica=1).result(timeout=300)
+            fleet.scale_to(3)
+            fleet.submit(g, 4, replica=2).result(timeout=300)
+            # Pre-compaction: each replica journaled exactly its own
+            # request on its own file.
+            for i in range(3):
+                view = J.read_journal(str(path) + f".replica{i}")
+                assert view["admits"] == 1, f"replica{i}"
+                assert not view["unresolved"]
+        finally:
+            fleet.shutdown(drain=True)
+    for i in range(3):
+        assert not J.read_journal(str(path) + f".replica{i}")["unresolved"]
+    assert not os.path.exists(path)  # nothing writes the bare path
+
+
+def test_drain_resteer_resolves_drained_replicas_journal(tmp_path):
+    """Work a fleet drain re-homes onto a sibling must be RESOLVED in
+    the drained replica's journal ('resteered') — an unresolved entry
+    there would replay already-completed work if the slot is revived."""
+    import warnings
+
+    from kaminpar_tpu.serve.fleet import PartitionFleet
+
+    path = tmp_path / "fleet.jsonl"
+    ctx = _ctx(path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        fleet = PartitionFleet(ctx, replicas=2, warm_ladder=(),
+                               warm_ks=(), queue_bound=8, max_batch=2)
+        fleet.start(warmup=False)
+        try:
+            g = _graphs(1)[0]
+            # Hold replica 0's queue, land work there, then drain it:
+            # the eager drain leg resteers the queued request to
+            # replica 1 where it completes.
+            fleet.replicas[0].pause()
+            fut = fleet.submit(g, 4, replica=0)
+            fleet.drain_replica(0, reason="test")
+            res = fut.result(timeout=300)
+            assert res is not None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if not J.read_journal(
+                    str(path) + ".replica0"
+                )["unresolved"]:
+                    break
+                time.sleep(0.1)
+            v0 = J.read_journal(str(path) + ".replica0")
+            assert not v0["unresolved"], "resteered entry left replayable"
+            v1 = J.read_journal(str(path) + ".replica1")
+            assert v1["admits"] == 1  # the sibling's journal owns it now
+        finally:
+            fleet.shutdown(drain=True)
+
+
+@pytest.mark.slow
+def test_sigkill_serve_cli_replays_journal(tmp_path):
+    """The real thing: SIGKILL (uncatchable) a serve CLI process
+    mid-burst, then replay its journal in-process — zero accepted
+    requests lost, zero duplicated resolutions."""
+    path = tmp_path / "cli.jsonl"
+    code = (
+        "import time\n"
+        "from kaminpar_tpu.graph import generators\n"
+        "from kaminpar_tpu.presets import create_context_by_preset_name\n"
+        "from kaminpar_tpu.serve.engine import PartitionEngine\n"
+        "ctx = create_context_by_preset_name('serve')\n"
+        "eng = PartitionEngine(ctx, warm_ladder=(), warm_ks=(),"
+        " queue_bound=32, max_batch=2)\n"
+        "eng.start(warmup=False)\n"
+        "eng.pause()\n"  # admits journal; nothing dispatches before kill
+        "for i in range(8):\n"
+        "    eng.submit(generators.rmat_graph(7, edge_factor=4,"
+        " seed=50 + i), 4)\n"
+        "print('ADMITTED', flush=True)\n"
+        "eng.resume()\n"
+        "time.sleep(600)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KPTPU_SERVE_JOURNAL=str(path))
+    child = subprocess.Popen(
+        [sys.executable, "-c", code], env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = child.stdout.readline()
+        assert "ADMITTED" in line
+        time.sleep(0.5)  # a few dispatches start; most stay queued
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=60)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    view = J.read_journal(str(path))
+    assert view["admits"] == 8
+    assert view["unresolved"]  # the kill landed mid-burst
+
+    eng = _engine(path, queue_bound=32, max_batch=2)
+    eng.start(warmup=False)
+    try:
+        assert _wait_unresolved_empty(path, timeout=600)
+        view = J.read_journal(str(path))
+        assert not view["unresolved"]
+        assert len(view["resolved"]) == 8
+        assert all(c == 1 for c in view["resolved"].values())
+    finally:
+        eng.shutdown(drain=True)
+    assert not J.read_journal(str(path))["unresolved"]

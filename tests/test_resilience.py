@@ -140,6 +140,62 @@ def test_fault_plan_parse():
         FaultPlan.parse("execute:bogus-class")
 
 
+def test_fault_plan_parse_rejects_malformed_values():
+    """Round-19 satellite: malformed plans raise a typed ValueError
+    NAMING the offending spec at arm time — silent partial arming would
+    let a chaos run claim coverage its plan never delivered."""
+    # Non-numeric values, each naming the key and the spec.
+    with pytest.raises(ValueError, match=r"malformed n=.*'abc'"):
+        FaultPlan.parse("execute:execute-fault:n=abc")
+    with pytest.raises(ValueError, match=r"malformed after="):
+        FaultPlan.parse("execute:execute-fault:after=1.5x")
+    with pytest.raises(ValueError, match=r"malformed p="):
+        FaultPlan.parse("execute:execute-fault:p=lots")
+    with pytest.raises(ValueError, match=r"malformed delay="):
+        FaultPlan.parse("execute:execute-fault:delay=soon")
+    # Out-of-range values.
+    with pytest.raises(ValueError, match=r"p=1\.5 outside"):
+        FaultPlan.parse("execute:execute-fault:p=1.5")
+    with pytest.raises(ValueError, match=r"n=-1 must be >= 0"):
+        FaultPlan.parse("execute:execute-fault:n=-1")
+    with pytest.raises(ValueError, match=r"after=-2 must be >= 0"):
+        FaultPlan.parse("execute:execute-fault:after=-2")
+    with pytest.raises(ValueError, match="unknown fault-spec key"):
+        FaultPlan.parse("execute:execute-fault:bogus=1")
+    # The offending SPEC rides the message (a multi-spec plan must name
+    # which entry is broken).
+    with pytest.raises(ValueError, match=r"execute:execute-fault:n=zz"):
+        FaultPlan.parse(
+            "readback:execute-fault:n=1,execute:execute-fault:n=zz"
+        )
+
+
+def test_fault_plan_parse_rejects_duplicate_specs():
+    """An EXACT copy of a spec could never add a firing — rejected at
+    arm time, not silently carried.  Same-(point, site, error) specs
+    with different firing parameters are legal STAGED plans (the
+    matcher falls through exhausted/after-gated specs)."""
+    with pytest.raises(ValueError, match="duplicate fault spec"):
+        FaultPlan.parse(
+            "execute:execute-fault:n=1,execute:execute-fault:n=1"
+        )
+    with pytest.raises(ValueError, match="duplicate fault spec"):
+        FaultPlan.parse(
+            "execute@site:execute-fault,execute@site:execute-fault"
+        )
+    # Staged plan: fire at hit 1 and again at hit 11 — NOT a duplicate.
+    plan = FaultPlan.parse(
+        "execute:execute-fault:n=1,execute:execute-fault:after=10:n=1"
+    )
+    assert len(plan.specs) == 2
+    # Different site or error class: NOT duplicates either.
+    plan = FaultPlan.parse(
+        "execute@a:execute-fault,execute@b:execute-fault,"
+        "execute@a:capacity-exceeded"
+    )
+    assert len(plan.specs) == 3
+
+
 def test_fault_injection_counts_and_site_filter():
     with injected_faults("execute@right:execute-fault:n=2") as plan:
         rfaults.maybe_inject("execute", site="wrong-site")  # filtered
@@ -225,6 +281,64 @@ def test_breaker_stale_probe_renewal():
     assert not br.allow()
     time.sleep(0.11)
     assert br.allow()  # stale -> probe 2
+    assert br.probes == 2
+
+
+def test_breaker_halfopen_probe_race_burns_one_slot():
+    """Round-19 satellite: N threads racing a cooled-down breaker must
+    burn exactly ONE probe slot — the open->half-open transition and the
+    probe claim are one locked step (a barrier lines the threads up on
+    the same instant)."""
+    br = CircuitBreaker(("x", ()), threshold=1, cooldown_s=0.05)
+    br.record_failure()
+    time.sleep(0.06)  # cooldown elapsed: the next allow() opens the race
+    n = 8
+    barrier = threading.Barrier(n)
+    grants: list = []
+    lock = threading.Lock()
+
+    def racer():
+        barrier.wait()
+        ok = br.allow()
+        with lock:
+            grants.append(ok)
+
+    threads = [threading.Thread(target=racer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert grants.count(True) == 1, grants
+    assert br.probes == 1
+    # The claimed probe stays exclusive until an outcome is recorded...
+    assert not br.allow()
+    assert not br.would_allow()
+    # ...and its success releases the claim by closing the breaker.
+    assert br.record_success()
+    assert br.allow()
+
+
+def test_breaker_would_allow_peek_vs_claim():
+    """would_allow() must stay a pure peek while a claimed probe is in
+    flight (False — the slot is taken), and would_allow(claim=True) is
+    the consuming twin of allow()."""
+    br = CircuitBreaker(("x", ()), threshold=1, cooldown_s=0.05)
+    br.record_failure()
+    time.sleep(0.06)
+    # Peek does not consume: repeated peeks all say "available".
+    assert br.would_allow() and br.would_allow()
+    assert br.probes == 0
+    # The claiming form consumes the one slot.
+    assert br.would_allow(claim=True)
+    assert br.probes == 1
+    assert not br.would_allow()
+    assert not br.would_allow(claim=True)
+    # A recorded outcome (failure) re-opens; after cooldown the cycle
+    # restarts with a fresh slot.
+    br.record_failure()
+    time.sleep(0.06)
+    assert br.would_allow()
+    assert br.allow()
     assert br.probes == 2
 
 
