@@ -1317,13 +1317,11 @@ def _recent_failures(telemetry: dict | None) -> int:
 
 
 def _git_head() -> str:
-    try:
-        return subprocess.run(
-            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout.strip()
-    except Exception:  # noqa: BLE001
-        return ""
+    # Round 20: one resolver (env override -> rev-parse -> ""), cached
+    # per process, shared with every ledger entry writer.
+    from kaminpar_tpu.telemetry.ledger import resolve_git_head
+
+    return resolve_git_head()
 
 
 def _salvage(stdout: str) -> dict | None:

@@ -771,6 +771,31 @@ class ServeContext:
     # what batched fsync does and does not guarantee).  Resolutions and
     # the warm-state record force an fsync regardless.
     journal_fsync_every: int = 8
+    # -- SLO objectives (round 20, telemetry/slo.py) ------------------------
+    # Declared service objectives; ALL default off (0.0), which disables
+    # burn-rate accounting entirely.  When any is armed the engine keeps
+    # rolling multi-window error budgets (slo_windows_s), exposes them in
+    # stats()["slo"] + kaminpar_slo_* Prometheus families, and exports a
+    # dimensionless pressure signal max(0, worst_burn - 1) that the fleet
+    # steering score and the autoscaler consume.  Pressure is a control
+    # input only — it never reaches the partitioning math, so partitions
+    # stay bit-identical with SLOs armed or off (asserted in tests).
+    #
+    # Per-quality-tier latency targets in milliseconds (queue wait +
+    # execute, i.e. the caller-observed service path of a completed
+    # request); a completed request over its tier's target spends latency
+    # error budget (budget = 1 - slo_availability, or 1% when no
+    # availability objective is set).
+    slo_strong_ms: float = 0.0
+    slo_fast_ms: float = 0.0
+    # Availability target as a fraction (e.g. 0.999): failed/expired
+    # requests spend the (1 - target) error budget.
+    slo_availability: float = 0.0
+    # Tolerated capacity-reject rate as a fraction of submissions (e.g.
+    # 0.01): typed CapacityError rejections beyond it burn budget.
+    slo_capacity_reject_rate: float = 0.0
+    # Rolling evaluation windows in seconds (fast burn / slow burn pair).
+    slo_windows_s: tuple = (60.0, 600.0)
 
 
 @dataclass
@@ -847,6 +872,18 @@ class FleetContext:
     autoscale_high_s: float = 1.0
     autoscale_low_s: float = 0.05
     autoscale_hysteresis: int = 3
+    # -- SLO pressure feedback (round 20, telemetry/slo.py) -----------------
+    # Weight of a replica's SLO burn pressure in the steering score, in
+    # service-time units per unit of excess burn: a replica burning its
+    # error budget looks "slower" to the router and sheds new load to
+    # healthier siblings.  Inert (term is 0) unless the engines' ServeContext
+    # arms SLO objectives.
+    steer_slo_weight: float = 1.0
+    # Seconds added to the autoscaler's mean drain estimate per unit of
+    # mean excess burn across active replicas: sustained budget burn pulls
+    # the fleet toward the high watermark (scale-up) even when raw queue
+    # depth alone would not cross it.  Inert unless SLOs are armed.
+    autoscale_slo_boost: float = 1.0
     # Replace (not just drain) a replica the health sweep takes out of
     # rotation — a fresh replica inheriting the fleet's warm state spawns
     # immediately so capacity does not dip for the drain cooldown.

@@ -13,7 +13,9 @@ Three modes:
 
 Observability (ISSUE 5): ``--metrics-port P`` serves the engine's Prometheus
 text exposition at ``http://127.0.0.1:P/metrics`` for the session's
-duration; ``--trace-out FILE`` records the whole session (engine queue
+duration — plus a JSON liveness probe at ``/healthz`` (round 20: queue and
+dispatcher liveness per replica with the SLO burn summary; 200 healthy,
+503 not); ``--trace-out FILE`` records the whole session (engine queue
 lifecycle events + pipeline spans + quality probes) as a Chrome trace.
 """
 
@@ -67,20 +69,54 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _health_snapshot(engine) -> dict:
+    """Liveness probe body (round 20): per-replica queue/dispatcher
+    liveness plus the SLO burn summary.  Deliberately cheap — no
+    ``stats()`` call, no device work — so a load balancer can poll it at
+    high frequency without perturbing the serve path it is probing."""
+    replicas = getattr(engine, "replicas", None) or [engine]
+    rows = []
+    for eng in replicas:
+        queue = getattr(eng, "_queue", None)
+        thread = getattr(eng, "_thread", None)
+        tracker = getattr(eng, "_slo", None)
+        rows.append({
+            "engine": getattr(eng, "name", "") or "engine",
+            "queue_open": bool(queue is not None and not queue.closed),
+            "dispatcher_alive": bool(thread is not None and thread.is_alive()),
+            "slo": (tracker.summary() if tracker is not None
+                    else {"armed": False}),
+        })
+    healthy = bool(rows) and all(
+        row["queue_open"] and row["dispatcher_alive"] for row in rows
+    )
+    return {"healthy": healthy, "replicas": rows}
+
+
 def _start_metrics_server(engine, port: int):
-    """Serve ``engine.metrics_text()`` at /metrics on a daemon thread;
+    """Serve ``engine.metrics_text()`` at /metrics and a JSON liveness
+    probe at /healthz (200 healthy / 503 not) on a daemon thread;
     returns the server (caller shuts it down)."""
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API
-            if self.path.split("?")[0].rstrip("/") in ("", "/metrics"):
+            path = self.path.split("?")[0].rstrip("/")
+            if path in ("", "/metrics"):
                 body = engine.metrics_text().encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
                 )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/healthz":
+                health = _health_snapshot(engine)
+                body = json.dumps(health).encode()
+                self.send_response(200 if health["healthy"] else 503)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

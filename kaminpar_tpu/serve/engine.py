@@ -188,6 +188,14 @@ class ServeRequest:
     # quality_fast ladder rung demotes strong requests per shape cell
     # under capacity-class failures (counted, reversible).
     quality: str = "strong"
+    # Request-scoped trace id (round 20, telemetry/reqtrace.py): minted at
+    # submit (or inherited from the fleet / the journal on replay) and
+    # carried for the request's whole life — one connected event chain per
+    # request even across resteers and crash replays.
+    trace_id: str = ""
+    # Queue depth observed at admission (stamped by BoundedServeQueue.put;
+    # rides the admit trace event).
+    queue_position: int = 0
     # The tier that actually served the request ("" until dispatch; may
     # differ from ``quality`` under a quality_strong demotion) — warm
     # accounting is tier-keyed, because the two tiers compile different
@@ -268,6 +276,16 @@ class PartitionEngine:
             )
         self._queue = BoundedServeQueue(self.serve.queue_bound)
         self.stats_ = ServeStats()
+        # Request-scoped tracing + SLO burn accounting (round 20,
+        # telemetry/{reqtrace,slo}.py).  A fleet replaces ``reqtrace`` with
+        # one registry shared across its replicas so resteered requests
+        # keep one connected event chain.  ``_slo`` is None unless the
+        # ServeContext arms at least one objective.
+        from ..telemetry.reqtrace import ReqTrace
+        from ..telemetry.slo import BurnTracker
+
+        self.reqtrace = ReqTrace()
+        self._slo = BurnTracker.from_serve(self.serve)
         # (n_bucket, k, tier) — warm-hit accounting, keyed by the quality
         # tier that served the cell (the two tiers compile different
         # executable sets, so a fast-served cell is not warm for strong).
@@ -705,6 +723,11 @@ class PartitionEngine:
                 f"{key} (dossier on engine.stats()['resilience'])",
                 site="watchdog",
             )):
+                # Watchdog faults are resteerable (site="watchdog") — the
+                # trace chain continues if the fleet re-homes the request.
+                self._trace_event(req, "error", final=False,
+                                  failure_class="worker-hung",
+                                  site="watchdog")
                 self.stats_.record_request(
                     time.monotonic() - req.enqueue_t, 0.0, failed=True
                 )
@@ -1024,6 +1047,10 @@ class PartitionEngine:
                     None if req.min_block_weights is None
                     else [int(x) for x in req.min_block_weights]
                 ),
+                # Trace continuity across crashes (round 20): replay
+                # re-binds the replayed request to this id, so the
+                # restarted process extends the SAME event chain.
+                "trace_id": req.trace_id,
                 "graph": _journal.encode_graph(req.graph),
             }
             self._journal.append(record)
@@ -1100,11 +1127,32 @@ class PartitionEngine:
                 min_epsilon=float(entry.get("min_epsilon", 0.0) or 0.0),
                 min_block_weights=entry.get("min_block_weights"),
                 quality=quality,
+                trace_id=str(entry.get("trace_id", "") or ""),
             )
             req.future.request_id = req.id
             req.future._on_done = (
                 lambda result, error, _id=int(entry["id"]):
                     self._journal_resolution(_id, result, error)
+            )
+            # Trace continuity (round 20): re-bind the journaled trace id
+            # (minting a fresh one only for pre-round-20 journals) under
+            # BOTH the new engine id and the original journal id, record a
+            # replayed admit + an explicit journal_replay hop — the
+            # restarted process extends the same event chain the dead one
+            # started, so explain() shows admit -> replay -> resolution
+            # connected.
+            if not req.trace_id:
+                req.trace_id = self.reqtrace.mint()
+            self.reqtrace.bind(req.id, req.trace_id)
+            self.reqtrace.bind(int(entry["id"]), req.trace_id)
+            self.reqtrace.record(
+                req.trace_id, "admit", request_id=req.id,
+                engine=self.name, k=req.k, quality=quality,
+                replayed=True, journal_id=int(entry["id"]),
+            )
+            self.reqtrace.record(
+                req.trace_id, "journal_replay", request_id=req.id,
+                engine=self.name, journal_id=int(entry["id"]),
             )
             self.stats_.record_warm(req.warm_hit)
             self._queue.put(req, force=True)
@@ -1172,6 +1220,7 @@ class PartitionEngine:
         min_epsilon: float = 0.0,
         min_block_weights: Optional[Sequence[int]] = None,
         quality: str = "strong",
+        trace_id: str = "",
     ) -> ServeFuture:
         """Enqueue one partition request; returns a :class:`ServeFuture`.
 
@@ -1186,7 +1235,12 @@ class PartitionEngine:
         ``quality``: "strong" (the engine's full pipeline) or "fast"
         (trimmed refinement — the tiered-SLO knob; strong requests can be
         demoted per cell by the quality_strong ladder rung under
-        capacity-class failures)."""
+        capacity-class failures).
+
+        ``trace_id``: request-scoped trace id (round 20) — the fleet
+        passes the id it minted at steer time so the engine extends the
+        same event chain; direct callers leave it empty and the engine
+        mints one (queryable via :meth:`explain`)."""
         if quality not in ("strong", "fast"):
             raise ValueError(
                 f"quality must be 'strong' or 'fast', got {quality!r}"
@@ -1197,8 +1251,16 @@ class PartitionEngine:
         from ..resilience.errors import PoisonedCell
         from ..resilience.faults import maybe_inject
 
+        tid = str(trace_id) or self.reqtrace.mint()
         maybe_inject("queue-admit", site="submit")
-        self._capacity_preflight(graph, k)
+        try:
+            self._capacity_preflight(graph, k)
+        except CapacityError:
+            self.reqtrace.record(tid, "reject", engine=self.name,
+                                 reason="capacity")
+            if self._slo is not None:
+                self._slo.record_reject(capacity=True)
+            raise
         cell = shape_cell(graph, k)
         cell_key = (cell.n_bucket, cell.m_bucket, cell.k)
         cell_breaker = self.breakers.get("cell", cell_key)
@@ -1207,6 +1269,8 @@ class PartitionEngine:
             # hint; the post-cooldown half-open probe re-admits ONE
             # request, and its success restores the cell.
             self.stats_.bump("rejected_poisoned")
+            self.reqtrace.record(tid, "reject", engine=self.name,
+                                 reason="poisoned")
             raise PoisonedCell(
                 cell_key, cell_breaker.retry_after_s(), site="submit"
             )
@@ -1229,6 +1293,7 @@ class PartitionEngine:
             min_epsilon=float(min_epsilon),
             min_block_weights=min_block_weights,
             quality=quality,
+            trace_id=tid,
         )
         req.future.request_id = req.id
         from ..telemetry import trace as ttrace
@@ -1255,11 +1320,22 @@ class PartitionEngine:
             retry_after = self.stats_.retry_after_estimate(
                 len(self._queue), self.serve.max_batch
             )
+            self.reqtrace.record(tid, "reject", engine=self.name,
+                                 reason="queue_full",
+                                 retry_after_s=round(retry_after, 3))
+            if self._slo is not None:
+                self._slo.record_reject(capacity=False)
             if rec is not None:
                 rec.instant("serve.reject", request_id=req.id,
                             retry_after_s=round(retry_after, 3))
             raise QueueFullError(retry_after) from None
         self.stats_.bump("admitted")
+        self.reqtrace.bind(req.id, tid)
+        self.reqtrace.record(
+            tid, "admit", request_id=req.id, engine=self.name, k=req.k,
+            n_bucket=cell.n_bucket, m_bucket=cell.m_bucket, warm_hit=warm,
+            quality=quality, queue_position=req.queue_position,
+        )
         if self._journal is not None:
             # Admitted => journaled: from here on, the only ways out of
             # the journal are a resolution record or a replay after
@@ -1302,6 +1378,56 @@ class PartitionEngine:
         )
         return fut.result().partition
 
+    # -- request tracing (round 20, telemetry/reqtrace.py) -----------------
+
+    def _final_error(self, error) -> bool:
+        """Whether a typed failure terminates the request's trace chain.
+        The "engine gave it back" classes (EngineStoppedError, WorkerHung,
+        watchdog/shutdown ExecuteFault) are resteerable or replayable —
+        the chain continues on a sibling replica or after restart."""
+        from ..resilience.errors import ExecuteFault, WorkerHung
+
+        if isinstance(error, (EngineStoppedError, WorkerHung)):
+            return False
+        return not (
+            isinstance(error, ExecuteFault)
+            and getattr(error, "site", "") in ("watchdog", "shutdown")
+        )
+
+    def _trace_event(self, req: ServeRequest, event: str,
+                     final: bool = False, **fields) -> None:
+        """Record one request-trace event (pure host dict append).  On a
+        terminal event (``final=True``) the request's whole chain is
+        rendered onto a per-request lane of the active Chrome trace."""
+        tid = req.trace_id
+        if not tid:
+            return
+        if event in ("resolve", "error"):
+            fields["final"] = bool(final)
+        self.reqtrace.record(tid, event, request_id=req.id,
+                             engine=self.name, **fields)
+        if final:
+            from ..telemetry import trace as ttrace
+
+            rec = ttrace.active()
+            if rec is not None:
+                from ..utils.timer import scoped_timer
+
+                with scoped_timer("reqtrace_export"):
+                    self.reqtrace.export_chrome(rec, tid)
+
+    def explain(self, request_id: int) -> Optional[dict]:
+        """Structured dossier for one request: its time-ordered trace
+        event chain (admit, dispatch, lane-stack cohort, demotion,
+        resolve/error, journal replay ...) plus a connectivity verdict —
+        ``None`` for unknown/evicted ids.  Pure host work (counted under
+        ``reqtrace_export``; a device pull here is a contract
+        violation)."""
+        from ..utils.timer import scoped_timer
+
+        with scoped_timer("reqtrace_export"):
+            return self.reqtrace.explain_request(int(request_id))
+
     # -- dispatcher --------------------------------------------------------
 
     def _loop(self) -> None:
@@ -1329,9 +1455,20 @@ class PartitionEngine:
                     self.breakers.get("cell", key).record_failure()
                 for req in batch:
                     if req.future._reject(err):
-                        self.stats_.record_request(
-                            time.monotonic() - req.enqueue_t, 0.0, failed=True
+                        self._trace_event(
+                            req, "error",
+                            final=self._final_error(err),
+                            failure_class=getattr(
+                                err, "failure_class", type(err).__name__
+                            ),
+                            site="dispatch",
                         )
+                        wait = time.monotonic() - req.enqueue_t
+                        self.stats_.record_request(wait, 0.0, failed=True)
+                        if self._slo is not None:
+                            self._slo.record_request(
+                                req.quality, wait, ok=False
+                            )
 
     def _execute_batch(self, batch: List[ServeRequest]) -> None:
         now = time.monotonic()
@@ -1339,9 +1476,17 @@ class PartitionEngine:
         for req in batch:
             if req.future.cancelled:
                 self.stats_.bump("cancelled")
+                self._trace_event(req, "error", final=True,
+                                  failure_class="cancelled")
                 req.future._reject(RequestCancelledError(f"request {req.id}"))
             elif req.expired(now):
                 self.stats_.bump("timed_out")
+                wait = now - req.enqueue_t
+                self._trace_event(req, "error", final=True,
+                                  failure_class="deadline",
+                                  queue_wait_ms=round(wait * 1e3, 1))
+                if self._slo is not None:
+                    self._slo.record_request(req.quality, wait, ok=False)
                 req.future._reject(DeadlineExceededError(
                     f"request {req.id} expired after "
                     f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue"
@@ -1350,10 +1495,16 @@ class PartitionEngine:
                 live.append(req)
             else:
                 self.stats_.bump("cancelled")
+                self._trace_event(req, "error", final=True,
+                                  failure_class="cancelled")
                 req.future._reject(RequestCancelledError(f"request {req.id}"))
         if not live:
             return
         self.stats_.record_batch(len(live))
+        for req in live:
+            # Batch-join lifecycle point: this request dispatches as part
+            # of a formed micro-batch (occupancy = the lane axis).
+            self._trace_event(req, "dispatch", occupancy=len(live))
         from ..telemetry import trace as ttrace
 
         rec = ttrace.active()
@@ -1548,6 +1699,7 @@ class PartitionEngine:
         self.stats_.bump("lanestacked_batches")
         self.stats_.bump("lanestacked_lanes", len(live))
         self.stats_.bump("lanestack_splits", report.splits)
+        lane_cohorts = getattr(report, "lane_cohorts", ()) or ()
         for i, req in enumerate(live):
             # One stacked program serves all lanes; each request's execute
             # share is the batch wall over occupancy, and the rest of the
@@ -1559,6 +1711,16 @@ class PartitionEngine:
             req.caps = report.caps[i]
             req.execute_s = share
             req.service_s = wall
+            # Lane-stack lifecycle point: which cohort of the stacked
+            # program this request's lane rode (cohort splits re-bucket
+            # lanes whose work graphs left the request cell).
+            self._trace_event(
+                req, "lanestack", lane=i,
+                cohort=(int(lane_cohorts[i])
+                        if i < len(lane_cohorts) else 0),
+                cohorts=report.cohorts, lanes=report.lanes,
+                splits=report.splits,
+            )
         return list(live)
 
     def _request_solver(self, req: ServeRequest):
@@ -1576,6 +1738,10 @@ class PartitionEngine:
             self.breakers.record_demotion(
                 "quality_strong", "capacity pressure in this cell"
             )
+            # Demotion-ladder lifecycle point: the quality_strong rung
+            # served this strong request with the fast tier.
+            self._trace_event(req, "demote", rung="quality_strong",
+                              served="fast")
             return self._get_fast_solver(), False
         return self._solver, True
 
@@ -1682,10 +1848,20 @@ class PartitionEngine:
                             # it must poison at admission, not burn a
                             # doomed dispatch per request.
                             self.breakers.get("cell", key).record_failure()
-                        self.stats_.record_request(
-                            req.queue_wait_s, time.perf_counter() - t0,
-                            failed=True,
+                        exec_s = time.perf_counter() - t0
+                        self._trace_event(
+                            req, "error", final=self._final_error(err),
+                            failure_class=err.failure_class,
+                            site="engine_request",
                         )
+                        self.stats_.record_request(
+                            req.queue_wait_s, exec_s, failed=True,
+                        )
+                        if self._slo is not None:
+                            self._slo.record_request(
+                                req.quality_served or req.quality,
+                                req.queue_wait_s + exec_s, ok=False,
+                            )
         if not ok:
             return
 
@@ -1732,6 +1908,18 @@ class PartitionEngine:
             self.stats_.record_request(
                 req.queue_wait_s, req.execute_s, service_s=req.service_s
             )
+            self._trace_event(
+                req, "resolve", final=True, cut=int(cuts[i]),
+                feasible=feasible, batch=len(ok),
+                quality=req.quality_served or req.quality,
+                queue_wait_ms=round(req.queue_wait_s * 1e3, 2),
+                execute_ms=round(req.execute_s * 1e3, 2),
+            )
+            if self._slo is not None:
+                self._slo.record_request(
+                    req.quality_served or req.quality,
+                    req.queue_wait_s + req.execute_s, ok=True,
+                )
             if rec is not None:
                 rec.instant(
                     "serve.resolve", request_id=req.id, cut=int(cuts[i]),
@@ -1756,6 +1944,13 @@ class PartitionEngine:
             "open_cell_breakers": self.breakers.open_count("cell"),
             "watchdog_timeouts": self.stats_.counter("watchdog_timeouts"),
             "max_batch": self.serve.max_batch,
+            # SLO control pressure (round 20): max(0, worst_burn - 1),
+            # briefly memoized — 0.0 whenever objectives are disarmed, so
+            # the steering score is unchanged unless a deployment arms
+            # them (bit-identity: control input only).
+            "slo_pressure": (
+                self._slo.pressure() if self._slo is not None else 0.0
+            ),
         }
 
     def cell_depth(self, cell: ShapeCell) -> int:
@@ -1814,6 +2009,18 @@ class PartitionEngine:
         # resolution counters ride the standard counter block above.
         if self._journal is not None:
             snap["journal"] = self._journal.snapshot()
+        # SLO burn surface (round 20, telemetry/slo.py): per-window
+        # error-budget burn rates + the control pressure the fleet
+        # steering/autoscale consume.  Pure host scan of the event ring,
+        # counted under slo_eval.
+        from ..utils.timer import scoped_timer
+
+        with scoped_timer("slo_eval"):
+            snap["slo"] = (
+                self._slo.summary() if self._slo is not None
+                else {"armed": False}
+            )
+        snap["reqtrace"] = self.reqtrace.snapshot()
         return snap
 
     def metrics_text(self) -> str:
@@ -1859,4 +2066,9 @@ class PartitionEngine:
             [({"source": "inherited"}, cells["inherited"]),
              ({"source": "local"}, cells["local"])],
         ))
+        # SLO burn families (round 20, telemetry/slo.py) — empty unless
+        # the ServeContext arms at least one objective.
+        from ..telemetry import slo as slo_mod
+
+        families.extend(slo_mod.prometheus_families(self._slo))
         return prometheus.render(families)

@@ -84,11 +84,11 @@ class _FleetRecord:
 
     __slots__ = (
         "fleet_id", "graph", "k", "epsilon", "kwargs", "graph_id",
-        "replica", "current", "attempts", "lock",
+        "replica", "current", "attempts", "lock", "trace_id",
     )
 
     def __init__(self, fleet_id: int, graph, k: int, epsilon: float,
-                 kwargs: dict, graph_id):
+                 kwargs: dict, graph_id, trace_id: str = ""):
         self.fleet_id = fleet_id
         self.graph = graph
         self.k = int(k)
@@ -99,6 +99,10 @@ class _FleetRecord:
         self.current: Optional[ServeFuture] = None
         self.attempts = 0
         self.lock = threading.Lock()
+        # Request-scoped trace id (round 20): minted at steer time and
+        # passed to every engine submit this record makes, so the whole
+        # cross-replica life is one connected event chain.
+        self.trace_id = str(trace_id)
 
 
 class FleetFuture:
@@ -250,6 +254,16 @@ class PartitionFleet:
             self.replicas.append(
                 PartitionEngine(rctx, name=f"replica{i}", **serve_overrides)
             )
+        # ONE request-trace registry for the whole fleet (round 20,
+        # telemetry/reqtrace.py): replicas share it so a request resteered
+        # off a draining replica keeps one connected event chain across
+        # engines (each engine-private registry would fragment the
+        # dossier).  _spawn_replica re-attaches it to fresh replicas.
+        from ..telemetry.reqtrace import ReqTrace
+
+        self.reqtrace = ReqTrace()
+        for eng in self.replicas:
+            eng.reqtrace = self.reqtrace
         # Fleet-scoped breaker registry (round 18): one "replica" breaker
         # per replica index — tripped by drain_replica, restored by the
         # half-open probe at steering time (which restarts the engine).
@@ -456,7 +470,14 @@ class PartitionFleet:
         the only occupancy division).  p99 term: tail execute latency.
         Batch-join bonus: a forming same-cell batch (0 < depth <
         max_batch) attracts the request so the lane axis fills before
-        load spills to the next device."""
+        load spills to the next device.
+
+        SLO pressure term (round 20, telemetry/slo.py): a replica burning
+        its declared error budget (max(0, worst_burn - 1), in
+        service-time units per unit of excess burn) looks slower to the
+        router and sheds new load to healthier siblings.  0 whenever
+        objectives are disarmed — a control input only, never a
+        partition input."""
         eng = self.replicas[idx]
         sig = eng.steer_signals()
         per = self._service_floor(eng)
@@ -465,6 +486,8 @@ class PartitionFleet:
             self.fleet_ctx.steer_queue_weight
             * sig["queue_depth"] * per / max_batch
             + self.fleet_ctx.steer_p99_weight * sig["p99_execute_s"]
+            + self.fleet_ctx.steer_slo_weight
+            * sig.get("slo_pressure", 0.0) * per
         )
         cell_d = eng.cell_depth(cell)
         if 0 < cell_d < max_batch:
@@ -528,6 +551,13 @@ class PartitionFleet:
         if meta is not None:
             meta["considered"] = considered
             meta["capacity_skips"] = capacity_skips
+            # Per-replica score inputs for the request-trace steer event
+            # (round 20): what the router saw when it ranked candidates.
+            meta["probes"] = list(probes)
+            meta["scores"] = [
+                {"replica": idx, "score": round(score, 6)}
+                for score, idx in scored
+            ]
         return probes + [idx for _, idx in scored]
 
     def _check_auto_drain(self) -> None:
@@ -683,6 +713,10 @@ class PartitionFleet:
         eng = PartitionEngine(
             rctx, name=f"replica{idx}", **self._serve_overrides
         )
+        # Fresh replicas join the fleet's shared request-trace registry
+        # (round 20): a request resteered onto this replica extends its
+        # original event chain.
+        eng.reqtrace = self.reqtrace
         donor = next(
             (
                 self.replicas[i] for i in self._active_indices()
@@ -799,17 +833,34 @@ class PartitionFleet:
         # retry_after_estimate: that one floors at 0.05 s as an
         # anti-busy-spin backpressure hint, and a floor would read an
         # IDLE fleet as permanently above any smaller high watermark.
-        estimates = [
-            len(eng._queue)
-            * eng.stats_.service_time_estimate()
-            / max(1, eng.serve.max_batch)
-            for idx, eng in enumerate(self.replicas)
-            if not self._draining[idx] and not self._retired[idx]
-            and eng.running
-        ]
+        estimates = []
+        pressures = []
+        for idx, eng in enumerate(self.replicas):
+            if (
+                self._draining[idx] or self._retired[idx]
+                or not eng.running
+            ):
+                continue
+            estimates.append(
+                len(eng._queue)
+                * eng.stats_.service_time_estimate()
+                / max(1, eng.serve.max_batch)
+            )
+            pressures.append(
+                eng._slo.pressure() if eng._slo is not None else 0.0
+            )
         if not estimates:
             return
         mean = sum(estimates) / len(estimates)
+        # SLO pressure boost (round 20, telemetry/slo.py): sustained
+        # error-budget burn reads as extra seconds on the drain estimate
+        # (autoscale_slo_boost seconds per unit of mean excess burn), so
+        # a fleet missing its objectives scales up before raw queue depth
+        # alone crosses the watermark.  0 whenever objectives are
+        # disarmed — the watermark arithmetic is then unchanged.
+        mean += (
+            fc.autoscale_slo_boost * sum(pressures) / len(pressures)
+        )
         active = len(self._active_indices())
         hysteresis = max(1, int(fc.autoscale_hysteresis))
         if mean > fc.autoscale_high_s and active < fc.autoscale_max_replicas:
@@ -919,7 +970,24 @@ class PartitionFleet:
                 self._unroutable(cell)
             rec_id = next(self._ids)
             record = _FleetRecord(
-                rec_id, graph, k, epsilon, request_kwargs, graph_id
+                rec_id, graph, k, epsilon, request_kwargs, graph_id,
+                trace_id=self.reqtrace.mint(),
+            )
+            self.reqtrace.bind_fleet(rec_id, record.trace_id)
+            # Steer-decision trace event (round 20): the candidate ranking
+            # and per-replica score inputs the router saw BEFORE the
+            # admission attempts — the engine's admit event that follows
+            # names the replica that actually took the request.
+            self.reqtrace.record(
+                record.trace_id, "steer", fleet_id=rec_id, k=int(k),
+                n_bucket=cell.n_bucket, m_bucket=cell.m_bucket,
+                candidates=list(candidates),
+                sticky_home=(-1 if home is None else int(home)),
+                pinned=(-1 if replica is None else int(replica)),
+                considered=meta.get("considered", 0),
+                capacity_skips=meta.get("capacity_skips", 0),
+                probes=meta.get("probes", []),
+                scores=meta.get("scores", []),
             )
             fut = self._submit_record(record, candidates, cell, graph, k)
         sticky_used = home is not None and record.replica == home
@@ -962,7 +1030,8 @@ class PartitionFleet:
             eng = self.replicas[idx]
             try:
                 fut = eng.submit(
-                    record.graph, record.k, record.epsilon, **record.kwargs
+                    record.graph, record.k, record.epsilon,
+                    trace_id=record.trace_id, **record.kwargs
                 )
             except CapacityError as exc:
                 last_capacity = exc
@@ -1160,7 +1229,7 @@ class PartitionFleet:
                     try:
                         fut = self.replicas[idx].submit(
                             record.graph, record.k, record.epsilon,
-                            **record.kwargs,
+                            trace_id=record.trace_id, **record.kwargs,
                         )
                     except QueueFullError as exc:
                         backpressure = exc
@@ -1168,6 +1237,15 @@ class PartitionFleet:
                     except (PoisonedCell, EngineStoppedError):
                         continue
                     old = record.current
+                    # Resteer-hop trace event (round 20): which replica
+                    # gave the request back and where it re-homed — the
+                    # new replica's admit event (same trace id) follows.
+                    self.reqtrace.record(
+                        record.trace_id, "resteer",
+                        fleet_id=record.fleet_id,
+                        from_replica=int(record.replica), replica=int(idx),
+                        attempt=record.attempts + 1,
+                    )
                     record.replica = idx
                     record.current = fut
                     record.attempts += 1
@@ -1273,6 +1351,9 @@ class PartitionFleet:
                 "ema_service_s": snap["ema_service_s"],
                 "warmup_inherited_cells": cells["inherited"],
                 "warmup_local_cells": cells["local"],
+                "slo_pressure": (
+                    eng._slo.pressure() if eng._slo is not None else 0.0
+                ),
             })
             agg_lanes += snap["lanestacked_lanes"]
             agg_occupancy += snap["batch_occupancy_max"]
@@ -1289,8 +1370,30 @@ class PartitionFleet:
             # claim (virtual devices serialize; TPU_NOTES round 18).
             "aggregate_occupancy": agg_occupancy,
             "aggregate_lanestacked_lanes": agg_lanes,
+            # Worst replica SLO pressure (round 20): the autoscale boost
+            # uses the mean; the dashboard headline wants the worst.
+            "slo_pressure": max(
+                (r["slo_pressure"] for r in per_replica), default=0.0
+            ),
+            "reqtrace": self.reqtrace.snapshot(),
             "breakers": self.breakers.snapshot(),
         }
+
+    def explain(self, request) -> Optional[dict]:
+        """Structured request dossier by :class:`FleetFuture` (or fleet
+        id, or raw trace id): the whole cross-replica event chain — steer
+        decision with score inputs, per-replica admits/dispatches,
+        resteer hops, journal replays, resolution — with a connectivity
+        verdict (telemetry/reqtrace.py).  ``None`` for unknown/evicted
+        requests."""
+        from ..utils.timer import scoped_timer
+
+        with scoped_timer("reqtrace_export"):
+            if isinstance(request, FleetFuture):
+                return self.reqtrace.explain_fleet(request.fleet_id)
+            if isinstance(request, str):
+                return self.reqtrace.dossier(request)
+            return self.reqtrace.explain_fleet(int(request))
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the fleet router (per-replica
@@ -1377,6 +1480,10 @@ class PartitionFleet:
              "lane x device occupancy figure (device claim on real "
              "meshes; virtual CPU devices serialize)",
              [({}, snap["aggregate_occupancy"])]),
+            ("kaminpar_slo_fleet_pressure", "gauge",
+             "Worst per-replica SLO error-budget pressure "
+             "(max(0, worst_burn - 1); 0 unless objectives are armed)",
+             [({}, snap["slo_pressure"])]),
         ]
         families.extend(rbreakers.prometheus_families(self.breakers))
         return prometheus.render(families)

@@ -333,6 +333,12 @@ class LaneStackReport:
     splits: int = 0
     levels: int = 0
     stacked_pulls: int = 0
+    # Per-lane cohort ordinal in request order (round 20): which cohort of
+    # this batch execution each request's lane rode — the isolated-node
+    # strip can move work graphs across stack buckets, so requests from
+    # one shape cell may split across cohorts.  The engine's request-trace
+    # lanestack event records it per request.
+    lane_cohorts: tuple = ()
     # The stacked kernel shapes this run actually dispatched: level-0
     # stack buckets plus every coarsening level's (layout signature, lane
     # count).  Together with (k, epsilon) this names the executable set,
@@ -987,6 +993,11 @@ class LaneStackRunner:
                 for l in lanes
             ])
             self.report.cohorts = len(cohorts)
+            lane_cohorts = [0] * len(lanes)
+            for ci, grp in enumerate(cohorts):
+                for li in grp:
+                    lane_cohorts[li] = ci
+            self.report.lane_cohorts = tuple(lane_cohorts)
             pre = sync_stats.phase_count("lanestack_coarsening")
             for grp in cohorts:
                 c = _Cohort(lanes=[lanes[i] for i in grp])

@@ -63,6 +63,12 @@ class BoundedServeQueue:
             if not force and len(self._dq) >= self.bound:
                 raise QueueFullError()
             self._dq.append(item)
+            # Stamp the depth observed at admission (round 20): the
+            # request-trace admit event records how deep in line this
+            # request started, which the post-hoc dossier correlates with
+            # its measured queue wait.
+            if hasattr(item, "queue_position"):
+                item.queue_position = len(self._dq)
             self._cv.notify_all()
 
     def pop_batch(self, max_batch: int, window_s: float = 0.0,

@@ -62,6 +62,39 @@ def default_path() -> str:
     return os.path.join(here, "RUNS.jsonl")
 
 
+_HEAD_CACHE: Optional[str] = None
+
+
+def resolve_git_head(force: bool = False) -> str:
+    """The short git head of the repo this module lives in, resolved ONCE
+    per process (round 20 satellite).  Fallback chain: ``KPTPU_GIT_HEAD``
+    env override (tests, hermetic CI sandboxes without a git binary) →
+    ``git rev-parse --short HEAD`` via subprocess → "" when neither works
+    (not a checkout, no git).  Before this existed every tier-1/bench
+    entry writer that did not thread its own head recorded
+    ``"git_head": ""`` — making ``stale_vs_head`` meaningless — because
+    :func:`build_entry` had no fallback of its own."""
+    global _HEAD_CACHE
+    if _HEAD_CACHE is not None and not force:
+        return _HEAD_CACHE
+    head = os.environ.get("KPTPU_GIT_HEAD", "")
+    if not head:
+        try:
+            import subprocess
+
+            here = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            proc = subprocess.run(
+                ["git", "-C", here, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            )
+            head = proc.stdout.strip() if proc.returncode == 0 else ""
+        except Exception:  # noqa: BLE001 — ledger writes must never fail
+            head = ""
+    _HEAD_CACHE = head
+    return head
+
+
 def metric_direction(key: str) -> Optional[str]:
     """'up' (higher is better), 'down' (lower is better), or None
     (uncompared).  Higher-better markers win ties: ``serve_vs_single`` is a
@@ -135,7 +168,8 @@ def build_entry(record: dict, *, kind: str, git_head: str = "",
         "ts": round(time.time(), 1),
         "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "kind": kind,
-        "git_head": git_head or record.get("git_head") or "",
+        "git_head": git_head or record.get("git_head")
+        or resolve_git_head(),
         "backend": record.get("backend", ""),
         "device_kind": record.get("device_kind", ""),
         "stale_vs_head": bool(record.get("stale_vs_head", False)),
@@ -353,3 +387,286 @@ def baseline_window(entries: List[dict], latest: dict,
         )
 
     return [e for e in entries if comparable(e)][-window:]
+
+
+# -- ledger analytics (round 20): trend + regression attribution -------------
+#
+# Everything below is pure stdlib over the already-parsed JSONL entries —
+# `tools report` must run on a machine with no jax at all (CI dashboards,
+# laptops reading a synced RUNS.jsonl), so nothing here may import from the
+# partitioner.
+
+#: A trend verdict needs a sustained relative move; one-entry jitter below
+#: this fraction of the prior median reads as "flat".
+TREND_TOL = 0.10
+
+#: Attribution floors: a phase wall must move by this many seconds and a
+#: census count by at least one unit before it can be named a suspect —
+#: without the floors, micro-phases with ~0 medians dominate every ranking
+#: through huge relative deltas that explain nothing.
+_ATTR_WALL_FLOOR_S = 0.02
+_ATTR_COUNT_FLOOR = 1.0
+
+
+def config_signature(entry: dict) -> tuple:
+    """The workload-configuration fingerprint of an entry — the
+    ``_CONFIG_KEYS`` it actually carries, as a hashable tuple.  Two
+    entries with the same (kind, backend, signature) are the same
+    experiment over time; everything else is apples-to-oranges."""
+    metrics = entry.get("metrics") or {}
+    return tuple(
+        (key, metrics.get(key)) for key in _CONFIG_KEYS
+        if metrics.get(key) is not None
+    )
+
+
+def group_entries(entries: List[dict]) -> Dict[tuple, List[dict]]:
+    """Entries grouped by (kind, backend, config signature), file order
+    (= chronological order — `append` only ever appends) preserved."""
+    groups: Dict[tuple, List[dict]] = {}
+    for entry in entries:
+        key = (str(entry.get("kind", "")), str(entry.get("backend", "")),
+               config_signature(entry))
+        groups.setdefault(key, []).append(entry)
+    return groups
+
+
+def metric_trends(entries: List[dict]) -> Dict[str, dict]:
+    """Per-metric trajectory over one group's entries (chronological).
+
+    For each comparable key present in >= 2 entries: first/last/min/max,
+    the median of all entries *before* the last one (the trend baseline),
+    the relative delta of the last entry vs that median, and a verdict —
+    ``regressed`` / ``improved`` when the move exceeds :data:`TREND_TOL`
+    in the metric's bad/good direction, else ``flat``.  Config keys are
+    constant within a group by construction and are skipped."""
+    series: Dict[str, List[float]] = {}
+    for entry in entries:
+        for key, value in _flat_comparables(entry).items():
+            if key in _CONFIG_KEYS:
+                continue
+            series.setdefault(key, []).append(float(value))
+    trends: Dict[str, dict] = {}
+    for key, values in series.items():
+        if len(values) < 2:
+            continue
+        last = values[-1]
+        prior_median = _median(values[:-1])
+        if prior_median != 0:
+            delta_rel = (last - prior_median) / abs(prior_median)
+        else:
+            delta_rel = 0.0 if last == 0 else float("inf")
+        direction = metric_direction(key)
+        verdict = "flat"
+        if abs(delta_rel) > TREND_TOL and direction != "neutral":
+            worse = delta_rel > 0 if direction == "down" else delta_rel < 0
+            verdict = "regressed" if worse else "improved"
+        trends[key] = {
+            "n": len(values),
+            "first": values[0],
+            "last": last,
+            "min": min(values),
+            "max": max(values),
+            "prior_median": prior_median,
+            "delta_rel": (round(delta_rel, 4)
+                          if delta_rel != float("inf") else None),
+            "direction": direction,
+            "verdict": verdict,
+        }
+    return trends
+
+
+def attribute(latest: dict, baseline: List[dict],
+              regressions: Optional[List[dict]] = None,
+              top: int = 3) -> List[dict]:
+    """Regression attribution: for each *headline* regression of ``latest``
+    vs ``baseline``, rank the co-moving ``phase.*`` walls and ``census.*``
+    counts as suspects.
+
+    The phase walls and censuses are the only sub-metrics the ledger
+    carries, and in practice one of them is where a wall regression
+    actually lives ("partition_wall_s moved because phase.refine_s
+    doubled") or what a census regression *is* ("host syncs went from 0
+    to 4").  A suspect must itself have moved beyond an absolute floor
+    (see ``_ATTR_*_FLOOR``); suspects are ranked by relative move, and
+    each regression names at most ``top`` of them."""
+    regs = regressions if regressions is not None else compare(latest, baseline)
+    if not regs:
+        return []
+    latest_vals = _flat_comparables(latest)
+    base_vals: Dict[str, List[float]] = {}
+    for entry in baseline:
+        for key, value in _flat_comparables(entry).items():
+            base_vals.setdefault(key, []).append(float(value))
+
+    suspects: List[dict] = []
+    for key, base in base_vals.items():
+        if not (key.startswith("phase.") or key.startswith("census.")):
+            continue
+        if key not in latest_vals:
+            continue
+        cur = float(latest_vals[key])
+        med = _median(base)
+        delta = cur - med
+        floor = (_ATTR_COUNT_FLOOR if key.startswith("census.")
+                 else _ATTR_WALL_FLOOR_S)
+        if abs(delta) < floor:
+            continue
+        rel = delta / abs(med) if med != 0 else float("inf")
+        suspects.append({
+            "metric": key,
+            "latest": cur,
+            "baseline_median": med,
+            "delta": round(delta, 6),
+            "delta_rel": round(rel, 4) if rel != float("inf") else None,
+        })
+    suspects.sort(
+        key=lambda s: (s["delta_rel"] is None,
+                       -(abs(s["delta_rel"]) if s["delta_rel"] is not None
+                         else abs(s["delta"]))),
+    )
+
+    out: List[dict] = []
+    for reg in regs:
+        metric = reg["metric"]
+        if metric.startswith("census."):
+            # a census regression IS its own attribution — name only itself
+            mine = [s for s in suspects if s["metric"] == metric]
+        elif metric.startswith("phase."):
+            mine = [s for s in suspects if s["metric"] == metric]
+        else:
+            # headline metric: every moved sub-metric is a candidate, but a
+            # wall regression is best explained by walls and a count
+            # regression by counts — keep the full ranked list and let the
+            # floor + ranking do the work.
+            mine = [s for s in suspects if s["metric"] != metric]
+        out.append({"metric": metric, "suspects": mine[:top]})
+    return out
+
+
+def build_report(entries: Optional[List[dict]] = None, *,
+                 path: Optional[str] = None,
+                 window: int = DEFAULT_WINDOW,
+                 kinds: Optional[List[str]] = None) -> dict:
+    """The full analytics report over a ledger: one row per
+    (kind, backend, config) group with its metric trends, the latest
+    entry's regressions vs its baseline window, and per-regression
+    attribution.  ``kinds`` filters groups (e.g. ["tier1", "chaos"])."""
+    if entries is None:
+        entries = read(path)
+    if kinds:
+        wanted = set(kinds)
+        entries = [e for e in entries if str(e.get("kind", "")) in wanted]
+    groups = group_entries(entries)
+
+    rows: List[dict] = []
+    for (kind, backend, cfg), group in sorted(
+            groups.items(), key=lambda kv: (kv[0][0], kv[0][1], str(kv[0][2]))):
+        latest = group[-1]
+        base = baseline_window(group, latest, window)
+        regs = compare(latest, base) if base else []
+        rows.append({
+            "kind": kind,
+            "backend": backend,
+            "config": dict(cfg),
+            "entries": len(group),
+            "first_iso": group[0].get("iso", ""),
+            "latest_iso": latest.get("iso", ""),
+            "latest_git_head": latest.get("git_head", ""),
+            "trends": metric_trends(group),
+            "regressions": regs,
+            "attribution": attribute(latest, base, regs) if regs else [],
+        })
+
+    regressed = [r for r in rows if r["regressions"]]
+    report = {
+        "schema": SCHEMA,
+        "window": int(window),
+        "summary": {
+            "entries": len(entries),
+            "groups": len(rows),
+            "regressed_groups": len(regressed),
+            "total_regressions": sum(len(r["regressions"]) for r in rows),
+            "trend_regressed_metrics": sum(
+                1 for r in rows for t in r["trends"].values()
+                if t["verdict"] == "regressed"),
+            "trend_improved_metrics": sum(
+                1 for r in rows for t in r["trends"].values()
+                if t["verdict"] == "improved"),
+        },
+        "groups": rows,
+    }
+    return report
+
+
+def _fmt_num(value: float) -> str:
+    if value is None:
+        return "-"
+    if value == int(value) and abs(value) < 1e9:
+        return str(int(value))
+    return f"{value:.4g}"
+
+
+def render_report_markdown(report: dict) -> str:
+    """Markdown rendering of :func:`build_report` — trend tables per
+    group, regressions with their attributed suspects inline."""
+    lines: List[str] = []
+    s = report["summary"]
+    lines.append("# Ledger report")
+    lines.append("")
+    lines.append(
+        f"{s['entries']} entries, {s['groups']} groups, "
+        f"{s['regressed_groups']} regressed "
+        f"({s['total_regressions']} regressions); trends: "
+        f"{s['trend_regressed_metrics']} regressed / "
+        f"{s['trend_improved_metrics']} improved "
+        f"(window={report['window']})")
+    for row in report["groups"]:
+        cfg = " ".join(f"{k}={v}" for k, v in row["config"].items())
+        title = f"{row['kind']} / {row['backend'] or '?'}"
+        if cfg:
+            title += f" / {cfg}"
+        lines.append("")
+        lines.append(f"## {title}")
+        lines.append("")
+        head = row["latest_git_head"] or "?"
+        lines.append(
+            f"{row['entries']} entries "
+            f"({row['first_iso']} .. {row['latest_iso']}), "
+            f"latest head `{head}`")
+        if row["trends"]:
+            lines.append("")
+            lines.append(
+                "| metric | n | first | median | latest | delta | verdict |")
+            lines.append("|---|---|---|---|---|---|---|")
+            for key in sorted(
+                    row["trends"],
+                    key=lambda k: (row["trends"][k]["verdict"] == "flat", k)):
+                t = row["trends"][key]
+                delta = ("inf" if t["delta_rel"] is None
+                         else f"{t['delta_rel'] * 100:+.1f}%")
+                lines.append(
+                    f"| {key} | {t['n']} | {_fmt_num(t['first'])} "
+                    f"| {_fmt_num(t['prior_median'])} "
+                    f"| {_fmt_num(t['last'])} | {delta} | {t['verdict']} |")
+        if row["regressions"]:
+            lines.append("")
+            lines.append("### Regressions (latest vs baseline window)")
+            attribution = {a["metric"]: a["suspects"]
+                           for a in row["attribution"]}
+            for reg in row["regressions"]:
+                base = reg.get("baseline_median",
+                               reg.get("baseline_max"))
+                lines.append(
+                    f"- **{reg['metric']}** [{reg['class']}]: "
+                    f"{_fmt_num(reg['latest'])} vs baseline "
+                    f"{_fmt_num(base)} (threshold {_fmt_num(reg['threshold'])})")
+                for sus in attribution.get(reg["metric"], []):
+                    rel = ("inf" if sus["delta_rel"] is None
+                           else f"{sus['delta_rel'] * 100:+.1f}%")
+                    lines.append(
+                        f"  - suspect {sus['metric']}: "
+                        f"{_fmt_num(sus['baseline_median'])} -> "
+                        f"{_fmt_num(sus['latest'])} ({rel})")
+    lines.append("")
+    return "\n".join(lines)

@@ -114,6 +114,15 @@ AUX_PHASES = (
     # pulls).
     "journal_write",
     "journal_replay",
+    # Request-scoped tracing + SLO accounting (round 20, ISSUE 20;
+    # telemetry/{reqtrace,slo}.py).  Both phases are pure host work —
+    # reqtrace_export renders a finished request's event chain onto a
+    # Chrome-trace lane / builds an explain() dossier; slo_eval scans the
+    # burn tracker's event ring for stats()/metrics.  A pull under either
+    # is a contract violation (request tracing adds ZERO blocking
+    # transfers by construction — the armed budget suites assert it).
+    "reqtrace_export",
+    "slo_eval",
 )
 
 KNOWN_PHASES = frozenset(CORE_PHASES + AUX_PHASES)

@@ -87,6 +87,14 @@ class TraceRecorder:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def to_us(self, t_perf: float) -> float:
+        """Convert a ``time.perf_counter()`` reading taken elsewhere into
+        this recorder's trace clock (µs since recorder start, clamped to
+        0).  Lets event stores that stamp their own perf_counter times —
+        the request-trace registry (telemetry/reqtrace.py) — replay onto
+        lanes of this trace without re-instrumenting."""
+        return max(0.0, (float(t_perf) - self._t0) * 1e6)
+
     def _tid(self) -> int:
         ident = threading.get_ident()
         tid = self._tids.get(ident)

@@ -223,7 +223,12 @@ def trace(argv) -> int:
     validation enforces what Perfetto/chrome://tracing require (monotonic
     per-thread timestamps, matched B/E pairs, numeric counter args).
     ``--out`` re-emits the validated trace (a load/validate/dump round
-    trip), ``--quality`` prints the embedded per-level quality rows."""
+    trip), ``--quality`` prints the embedded per-level quality rows.
+
+    Exit codes are typed (round 20 hardening — CI scripts branch on
+    them): 0 valid, 1 structurally invalid trace, 2 unreadable file,
+    3 malformed/truncated JSON, 4 span-free capture (nothing to look
+    at — usually a run that crashed before the first phase closed)."""
     import json
 
     p = argparse.ArgumentParser(prog="trace")
@@ -239,13 +244,27 @@ def trace(argv) -> int:
     args = p.parse_args(argv)
     from ..telemetry.trace import shard_lane_summary, validate_chrome_trace
 
-    with open(args.trace) as fh:
-        obj = json.load(fh)
+    try:
+        with open(args.trace) as fh:
+            obj = json.load(fh)
+    except OSError as exc:
+        print(f"error: cannot read trace: {exc}")
+        return 2
+    except json.JSONDecodeError as exc:
+        print(f"error: malformed trace JSON (truncated capture?): {exc}")
+        return 3
+    if not isinstance(obj, dict):
+        print("error: malformed trace JSON: top level is not an object")
+        return 3
     try:
         summary = validate_chrome_trace(obj)
     except ValueError as exc:
         print(f"error: invalid trace: {exc}")
         return 1
+    if summary["spans"] == 0:
+        print("error: trace has no spans (empty or counter-only capture "
+              "— did the run crash before the first phase closed?)")
+        return 4
     other = obj.get("otherData") or {}
     print(f"Trace: {args.trace}")
     print(f"  events: {summary['events']} (spans {summary['spans']}, "
@@ -378,16 +397,28 @@ def regress(argv) -> int:
     if args.quality_tol is not None:
         kwargs["quality_tol"] = args.quality_tol
     regressions = led.compare(latest, window, **kwargs)
+    # Round 20: the ledger-wide report summary rides the sentinel so one
+    # `regress --json` call answers both "did the newest run regress?"
+    # and "how is the whole ledger trending?" without a second pass.
+    report_summary = led.build_report(
+        entries, window=args.window or led.DEFAULT_WINDOW)["summary"]
     if args.as_json:
         print(json.dumps({
             "latest_iso": latest.get("iso"),
             "baseline_entries": len(window),
             "regressions": regressions,
+            "report_summary": report_summary,
         }))
     else:
         print(
             f"regress: latest {latest.get('iso')} ({latest.get('kind')}/"
             f"{latest.get('backend')}) vs {len(window)} baseline entries"
+        )
+        print(
+            f"  ledger: {report_summary['groups']} groups, "
+            f"{report_summary['regressed_groups']} regressed, trends "
+            f"{report_summary['trend_regressed_metrics']} down / "
+            f"{report_summary['trend_improved_metrics']} up"
         )
         for reg in regressions:
             ref = reg.get("baseline_median", reg.get("baseline_max"))
@@ -399,6 +430,52 @@ def regress(argv) -> int:
         if not regressions:
             print("  no regressions")
     return 1 if regressions else 0
+
+
+def report(argv) -> int:
+    """Ledger analytics report (round 20; telemetry/ledger.py): render
+    RUNS.jsonl into a per-(kind, backend, workload) trend report — metric
+    trajectories over time, the latest entry's regressions vs its noise-
+    aware baseline window, and per-regression *attribution* (which
+    ``phase.*`` wall or ``census.*`` count co-moved when a headline
+    metric regressed).  Pure stdlib over the JSONL — runs jax-free, so a
+    dashboard box with only the RUNS.jsonl file can render it."""
+    import json
+
+    p = argparse.ArgumentParser(prog="report")
+    p.add_argument("--runs", default=None, metavar="PATH",
+                   help="ledger path (default: RUNS.jsonl in the repo root)")
+    p.add_argument("--window", type=int, default=None,
+                   help="baseline entries per group (default 5)")
+    p.add_argument("--kind", action="append", default=None, metavar="KIND",
+                   help="only these entry kinds (repeatable, e.g. "
+                        "--kind tier1 --kind chaos)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the structured report instead of markdown")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the report to this path instead of stdout")
+    args = p.parse_args(argv)
+    import os
+
+    from ..telemetry import ledger as led
+
+    path = args.runs or led.default_path()
+    if not os.path.exists(path):
+        print(f"error: no ledger at {path}")
+        return 2
+    rep = led.build_report(
+        path=path, window=args.window or led.DEFAULT_WINDOW, kinds=args.kind)
+    text = (json.dumps(rep, indent=2) if args.as_json
+            else led.render_report_markdown(rep))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text if text.endswith("\n") else text + "\n")
+        s = rep["summary"]
+        print(f"wrote report for {s['entries']} entries / {s['groups']} "
+              f"groups to {args.out}")
+    else:
+        print(text)
+    return 0
 
 
 def capacity(argv) -> int:
@@ -982,6 +1059,7 @@ REGISTRY = {
     "compression": compression,
     "rearrange": rearrange,
     "regress": regress,
+    "report": report,
     "resume": resume,
     "warmup": warmup,
     "trace": trace,

@@ -557,6 +557,8 @@ def test_dist_compressed_vs_single_device_deep():
     assert w.max() <= limit
 
 
+@pytest.mark.slow  # out-of-envelope fallback sweep (~17 s); in-envelope
+# bit-identity stays tier-1 across P in {1,2,8} (round-20 tier-1 rebalance)
 def test_dist_compressed_fallback_outside_envelope(capsys):
     """Outside the envelope (HEM clustering) the view gate falls back to the
     dense staging path — loudly under device_decode=finest — and the

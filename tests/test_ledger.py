@@ -234,3 +234,153 @@ def test_probe_telemetry_compresses_attempts(tmp_path, monkeypatch):
     assert summary["last_outcome"] == "ambient_is_cpu"
     # the summary is fixed-size: growing the log 10x must not grow it
     assert len(json.dumps(summary)) < 2000
+
+
+# -- git head resolution (round 20 satellite) --------------------------------
+
+
+def test_resolve_git_head_fallback_chain(monkeypatch):
+    """Env override -> subprocess rev-parse -> ""; cached once resolved,
+    and build_entry falls back to it so tier-1/bench entries written with
+    no explicit head stop recording git_head=""."""
+    monkeypatch.setenv("KPTPU_GIT_HEAD", "feedc0de")
+    assert ledger.resolve_git_head(force=True) == "feedc0de"
+    # cached: later env changes are invisible without force
+    monkeypatch.setenv("KPTPU_GIT_HEAD", "other")
+    assert ledger.resolve_git_head() == "feedc0de"
+    monkeypatch.delenv("KPTPU_GIT_HEAD")
+    head = ledger.resolve_git_head(force=True)
+    assert head, "this repo is a git checkout: rev-parse must resolve"
+    assert head != "feedc0de"
+
+    entry = ledger.build_entry(_record(), kind="tier1")
+    assert entry["git_head"] == head
+    # an explicit head (or one carried by the record) still wins
+    assert ledger.build_entry(
+        _record(), kind="tier1", git_head="abc1234")["git_head"] == "abc1234"
+    assert ledger.build_entry(
+        _record(git_head="def5678"), kind="tier1")["git_head"] == "def5678"
+
+
+# -- ledger analytics (round 20 tentpole c) ----------------------------------
+
+
+def _series(n=6, regress_last=False):
+    """n chronological same-workload entries; optionally the last one
+    carries an injected 2.5x wall regression living in one phase."""
+    entries = []
+    for i in range(n):
+        bad = regress_last and i == n - 1
+        entries.append(ledger.build_entry(_record(
+            partition_wall_s=300.0 if bad else 120.0,
+            phase_walls_s={"partitioning": 290.0 if bad else 110.0,
+                           "lp_bench_fence": 4.0},
+        ), kind="bench"))
+    return entries
+
+
+def test_metric_trends_verdicts():
+    trends = ledger.metric_trends(_series(regress_last=True))
+    wall = trends["partition_wall_s"]
+    assert wall["n"] == 6
+    assert wall["prior_median"] == 120.0 and wall["last"] == 300.0
+    assert wall["verdict"] == "regressed"
+    assert trends["phase.partitioning_s"]["verdict"] == "regressed"
+    assert trends["partition_cut"]["verdict"] == "flat"
+    # an improving higher-better metric reads as improved
+    up = [ledger.build_entry(_record(value=1e6), kind="bench")
+          for _ in range(3)]
+    up.append(ledger.build_entry(_record(value=2e6), kind="bench"))
+    assert ledger.metric_trends(up)["value"]["verdict"] == "improved"
+    # single-entry groups have no trend
+    assert ledger.metric_trends(_series(n=1)) == {}
+
+
+def test_attribute_names_co_moving_phase():
+    entries = _series(regress_last=True)
+    latest, base = entries[-1], entries[:-1]
+    regs = ledger.compare(latest, base)
+    assert any(r["metric"] == "partition_wall_s" for r in regs)
+    attr = {a["metric"]: a["suspects"]
+            for a in ledger.attribute(latest, base, regs)}
+    suspects = [s["metric"] for s in attr["partition_wall_s"]]
+    assert "phase.partitioning_s" in suspects
+    # the stable phase is NOT a suspect (below the movement floor)
+    assert "phase.lp_bench_fence_s" not in suspects
+    top = attr["partition_wall_s"][0]
+    assert top["metric"] == "phase.partitioning_s"
+    assert top["latest"] == 290.0 and top["baseline_median"] == 110.0
+    # a quiet series produces no attribution at all
+    assert ledger.attribute(_series()[-1], _series()[:-1]) == []
+
+
+def test_build_report_groups_and_markdown(tmp_path):
+    path = str(tmp_path / "RUNS.jsonl")
+    for entry in _series(regress_last=True):
+        ledger.append(entry, path)
+    # a second, quiet group with a different kind
+    for _ in range(3):
+        ledger.append(ledger.build_entry(_record(), kind="prober"), path)
+
+    rep = ledger.build_report(path=path)
+    assert rep["summary"]["entries"] == 9
+    assert rep["summary"]["groups"] == 2
+    assert rep["summary"]["regressed_groups"] == 1
+    assert rep["summary"]["total_regressions"] >= 1
+    bench_row = next(r for r in rep["groups"] if r["kind"] == "bench")
+    assert bench_row["regressions"] and bench_row["attribution"]
+    prober_row = next(r for r in rep["groups"] if r["kind"] == "prober")
+    assert not prober_row["regressions"]
+
+    md = ledger.render_report_markdown(rep)
+    assert "# Ledger report" in md
+    assert "## bench" in md and "## prober" in md
+    assert "partition_wall_s" in md
+    assert "suspect phase.partitioning_s" in md
+
+    # kind filter narrows the report
+    only = ledger.build_report(path=path, kinds=["prober"])
+    assert only["summary"]["groups"] == 1
+    assert only["groups"][0]["kind"] == "prober"
+
+
+def test_tools_report_cli_and_regress_summary(tmp_path, capsys):
+    """Acceptance (ISSUE 20c): ``tools report`` renders the ledger
+    jax-free, attributes the injected regression fixture, and its
+    summary keys ride the ``tools regress`` sentinel."""
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    path = str(tmp_path / "RUNS.jsonl")
+    for entry in _series(regress_last=True):
+        ledger.append(entry, path)
+
+    assert tools_main(["report", "--runs", path]) == 0
+    md = capsys.readouterr().out
+    assert "# Ledger report" in md
+    assert "suspect phase.partitioning_s" in md
+
+    assert tools_main(["report", "--runs", path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["summary"]["regressed_groups"] == 1
+    suspects = [s["metric"]
+                for a in rep["groups"][0]["attribution"]
+                for s in a["suspects"]]
+    assert "phase.partitioning_s" in suspects
+
+    out = tmp_path / "report.md"
+    assert tools_main(["report", "--runs", path, "--out", str(out)]) == 0
+    capsys.readouterr()
+    assert "suspect phase.partitioning_s" in out.read_text()
+
+    # a missing ledger is a typed failure
+    assert tools_main(["report", "--runs",
+                       str(tmp_path / "NONE.jsonl")]) == 2
+    capsys.readouterr()
+
+    # the regress sentinel carries the report summary keys
+    assert tools_main(["regress", "--runs", path, "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["regressions"]
+    summ = payload["report_summary"]
+    assert summ["groups"] == 1 and summ["regressed_groups"] == 1
+    assert summ["trend_regressed_metrics"] >= 1

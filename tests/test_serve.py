@@ -333,3 +333,53 @@ def test_batched_bit_identity_sweep():
                 )
                 if name == "rmat":
                     assert max(occ) >= 1, (name, scale, k, occ)
+
+
+# -- serve CLI metrics/health endpoints (round 20 satellite) ------------------
+
+
+def test_metrics_server_serves_metrics_and_healthz():
+    """One HTTP server, two endpoints: /metrics stays the Prometheus
+    exposition, /healthz answers 200 with queue/dispatcher liveness and
+    the SLO burn summary while the engine lives — and 503 once it stops."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kaminpar_tpu.serve.__main__ import _start_metrics_server
+
+    eng = PartitionEngine("serve", slo_strong_ms=250.0, **SMALL)
+    eng.start(warmup=False)
+    server = _start_metrics_server(eng, 0)  # port 0: ephemeral
+    port = server.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        assert "kaminpar_serve_queue_depth" in body
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            assert resp.status == 200
+            health = _json.loads(resp.read())
+        assert health["healthy"] is True
+        (row,) = health["replicas"]
+        assert row["queue_open"] and row["dispatcher_alive"]
+        assert row["slo"]["armed"] is True
+        assert "worst_burn" in row["slo"]
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert exc_info.value.code == 404
+
+        eng.shutdown(drain=True)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert exc_info.value.code == 503
+        assert _json.loads(exc_info.value.read())["healthy"] is False
+    finally:
+        eng.shutdown(drain=False)
+        server.shutdown()

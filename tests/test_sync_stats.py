@@ -118,6 +118,9 @@ def _coarsen_all(graph, ctx, target_n=128):
     return coarsener
 
 
+@pytest.mark.slow  # heavy scale-12 x {xla,pallas} matrix (~55 s); the same
+# one-readback-per-level budget is asserted at pipeline scale below in
+# test_coarsening_budget_asserted_in_deep_pipeline (round-20 tier-1 rebalance)
 @pytest.mark.parametrize("lp_kernel", ["xla", "pallas"])
 def test_coarsening_level_single_readback_scale12(lp_kernel):
     """Acceptance (ISSUE 2 + ISSUE 5): blocking device->host transfers per

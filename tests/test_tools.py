@@ -121,3 +121,48 @@ def test_warmup_tool():
     assert "cell n_bucket=" in out.stdout
     assert "lanestack cell" in out.stdout and "lanes=2" in out.stdout
     assert "distinct kernel specializations" in out.stdout
+
+
+# -- tools trace hardening (round 20 satellite) ------------------------------
+
+
+def test_tools_trace_typed_error_exit_codes(tmp_path, capsys):
+    """Malformed inputs get typed errors, not tracebacks: 2 unreadable
+    file, 3 malformed/truncated JSON, 4 span-free capture."""
+    import json
+
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    assert tools_main(["trace", str(tmp_path / "nope.json")]) == 2
+    assert "cannot read trace" in capsys.readouterr().out
+
+    truncated = tmp_path / "truncated.json"
+    truncated.write_text('{"traceEvents": [{"name": "x", "ph"')
+    assert tools_main(["trace", str(truncated)]) == 3
+    assert "malformed trace JSON" in capsys.readouterr().out
+
+    not_obj = tmp_path / "list.json"
+    not_obj.write_text("[1, 2, 3]")
+    assert tools_main(["trace", str(not_obj)]) == 3
+    capsys.readouterr()
+
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
+    assert tools_main(["trace", str(empty)]) == 4
+    assert "no spans" in capsys.readouterr().out
+
+
+def test_tools_trace_shards_without_shard_lanes(tmp_path, capsys):
+    """Regression guard: ``--shards`` on a valid trace with no shard
+    lanes reports their absence and exits 0 (it used to be exercised
+    only on mesh traces)."""
+    from kaminpar_tpu.telemetry import trace as ttrace
+    from kaminpar_tpu.tools.__main__ import main as tools_main
+
+    rec = ttrace.TraceRecorder()
+    rec.begin("partitioning")
+    rec.end("partitioning")
+    path = tmp_path / "single.json"
+    rec.write(str(path))
+    assert tools_main(["trace", str(path), "--shards"]) == 0
+    assert "not a mesh trace" in capsys.readouterr().out
